@@ -1,0 +1,19 @@
+"""dragonboat_tpu — a TPU-native multi-group Raft framework.
+
+A brand-new framework with the capabilities of dragonboat (the reference Go
+library): a NodeHost hosts many Raft shards with pluggable state machines,
+log storage and transport.  Unlike the reference's goroutine-pool engine
+(``engine.go``), the per-shard Raft step loop is a batched, vmapped JAX/XLA
+kernel advancing all shards in lockstep per step; host-side pipelines handle
+fsync, transport and snapshots.
+
+Public surface (parity with the reference's top-level package):
+
+- :class:`dragonboat_tpu.nodehost.NodeHost` — the host façade
+- :mod:`dragonboat_tpu.statemachine` — user state-machine interfaces
+- :mod:`dragonboat_tpu.config` — Config / NodeHostConfig
+- :mod:`dragonboat_tpu.raftio` — ILogDB / ITransport / listener interfaces
+- :mod:`dragonboat_tpu.client` — client sessions
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,118 @@
+"""raftpb record/serialization tests — round-trip and predicate parity."""
+
+from dragonboat_tpu import raftpb as pb
+
+
+def test_message_type_values_match_reference():
+    # parity: /root/reference/raftpb/types.go:8-38
+    assert pb.MessageType.LOCAL_TICK == 0
+    assert pb.MessageType.PROPOSE == 7
+    assert pb.MessageType.REPLICATE == 12
+    assert pb.MessageType.REPLICATE_RESP == 13
+    assert pb.MessageType.REQUEST_VOTE == 14
+    assert pb.MessageType.INSTALL_SNAPSHOT == 16
+    assert pb.MessageType.HEARTBEAT == 17
+    assert pb.MessageType.READ_INDEX == 19
+    assert pb.MessageType.TIMEOUT_NOW == 24
+    assert pb.MessageType.REQUEST_PREVOTE == 26
+    assert pb.MessageType.LOG_QUERY == 28
+    assert pb.NUM_MESSAGE_TYPES == 29
+
+
+def test_entry_roundtrip():
+    e = pb.Entry(term=3, index=17, type=pb.EntryType.APPLICATION,
+                 key=99, client_id=12345, series_id=2, responded_to=1,
+                 cmd=b"hello world")
+    buf = bytearray()
+    pb.encode_entry(e, buf)
+    got, off = pb.decode_entry(memoryview(bytes(buf)), 0)
+    assert got == e
+    assert off == len(buf)
+
+
+def test_state_roundtrip():
+    s = pb.State(term=7, vote=2, commit=55)
+    assert pb.decode_state(pb.encode_state(s)) == s
+    assert pb.State().is_empty()
+    assert not s.is_empty()
+
+
+def test_message_batch_roundtrip():
+    snap = pb.Snapshot(
+        filepath="/tmp/snap.gbsnap", file_size=1024, index=10, term=2,
+        membership=pb.Membership(
+            config_change_id=3,
+            addresses={1: "a1", 2: "a2"},
+            non_votings={4: "a4"},
+            witnesses={5: "a5"},
+            removed={9: True},
+        ),
+        files=(pb.SnapshotFile(1, "/tmp/f1", b"meta"),),
+        checksum=b"\x01\x02",
+        shard_id=7,
+        type=pb.StateMachineType.REGULAR,
+        on_disk_index=5,
+    )
+    msgs = (
+        pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1, shard_id=7,
+                   term=3, log_term=2, log_index=9, commit=8,
+                   entries=(pb.Entry(term=3, index=10, cmd=b"x" * 16),)),
+        pb.Message(type=pb.MessageType.INSTALL_SNAPSHOT, to=3, from_=1,
+                   shard_id=7, term=3, snapshot=snap),
+        pb.Message(type=pb.MessageType.HEARTBEAT_RESP, to=1, from_=2,
+                   shard_id=7, term=3, hint=123, hint_high=456, reject=True),
+    )
+    b = pb.MessageBatch(requests=msgs, deployment_id=42, source_address="h1:9876",
+                        bin_ver=1)
+    got = pb.decode_message_batch(pb.encode_message_batch(b))
+    assert got == b
+
+
+def test_message_batch_checksum():
+    b = pb.MessageBatch(requests=(pb.Message(type=pb.MessageType.PING),))
+    data = bytearray(pb.encode_message_batch(b))
+    data[10] ^= 0xFF
+    try:
+        pb.decode_message_batch(bytes(data))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("corrupted batch must fail checksum")
+
+
+def test_bootstrap_and_config_change_roundtrip():
+    bs = pb.Bootstrap(addresses={1: "x:1", 2: "y:2"}, join=True,
+                      type=pb.StateMachineType.ON_DISK)
+    assert pb.decode_bootstrap(pb.encode_bootstrap(bs)) == bs
+    cc = pb.ConfigChange(config_change_id=9, type=pb.ConfigChangeType.ADD_WITNESS,
+                         replica_id=5, address="z:3", initialize=True)
+    assert pb.decode_config_change(pb.encode_config_change(cc)) == cc
+
+
+def test_entry_predicates():
+    # parity: raftpb/raft.go:63-140 predicate semantics
+    cc = pb.Entry(type=pb.EntryType.CONFIG_CHANGE, cmd=b"cfg")
+    assert cc.is_config_change() and not cc.is_session_managed()
+    noop_session = pb.Entry(client_id=0, series_id=pb.NOOP_SERIES_ID, cmd=b"v")
+    assert noop_session.is_noop_session()
+    assert not noop_session.is_session_managed()
+    reg = pb.Entry(client_id=7, series_id=pb.SERIES_ID_FOR_REGISTER)
+    assert reg.is_new_session_request() and not reg.is_update()
+    unreg = pb.Entry(client_id=7, series_id=pb.SERIES_ID_FOR_UNREGISTER)
+    assert unreg.is_end_of_session_request()
+    upd = pb.Entry(client_id=7, series_id=3, cmd=b"v")
+    assert upd.is_update() and upd.is_session_managed() and upd.is_proposal()
+
+
+def test_entries_to_apply():
+    ents = tuple(pb.Entry(term=1, index=i) for i in range(5, 11))
+    assert pb.entries_to_apply(ents, 4) == ents
+    assert pb.entries_to_apply(ents, 7)[0].index == 8
+    assert pb.entries_to_apply(ents, 10) == ()
+    assert pb.entries_to_apply((), 3) == ()
+    try:
+        pb.entries_to_apply(ents, 3)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("gap must raise")
