@@ -1,0 +1,12 @@
+"""dragonboat_tpu.core — the Raft protocol core.
+
+Two interchangeable executors implement the same message-in/Update-out
+contract (the reference models all raft inputs as messages,
+``internal/raft/peer.go:30-37``):
+
+- :mod:`.pycore` — full-fidelity single-shard core in plain Python.  Runs the
+  etcd-derived conformance suites and serves as the host slow path for
+  variable-width operations (snapshot install, membership restore).
+- :mod:`.kernel` — the batched SoA JAX kernel advancing ``[G]`` shards in
+  lockstep per step; differentially tested against :mod:`.pycore`.
+"""

@@ -1,0 +1,136 @@
+"""Test harness: in-memory message router over Raft cores.
+
+The model for this fixture is the reference's etcd-derived ``network`` test
+router (raft_etcd_test.go:2896-2913, with the ``blackHole`` drop target at
+:3036): instances are stepped message-by-message until the network drains,
+with optional drop/isolate filters.  Used by the conformance suites and by
+the kernel differential tests.
+"""
+
+from __future__ import annotations
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.core.logentry import InMemoryLogDB
+from dragonboat_tpu.core.pycore import CoreConfig, Raft, RaftState
+
+
+def new_raft(
+    replica_id: int,
+    peers: list[int],
+    election: int = 10,
+    heartbeat: int = 1,
+    *,
+    check_quorum: bool = False,
+    pre_vote: bool = False,
+    logdb: InMemoryLogDB | None = None,
+    non_votings: list[int] | None = None,
+    witnesses: list[int] | None = None,
+    is_non_voting: bool = False,
+    is_witness: bool = False,
+    rng=None,
+) -> Raft:
+    cfg = CoreConfig(
+        shard_id=1,
+        replica_id=replica_id,
+        election_rtt=election,
+        heartbeat_rtt=heartbeat,
+        check_quorum=check_quorum,
+        pre_vote=pre_vote,
+        is_non_voting=is_non_voting,
+        is_witness=is_witness,
+    )
+    # deterministic per-replica randomized timeouts: replica i gets
+    # election_rtt + (i-1), so lower ids campaign first under tick_all
+    r = Raft(cfg, logdb if logdb is not None else InMemoryLogDB(),
+             rng=rng if rng is not None else (lambda n, i=replica_id: (i - 1) % n))
+    r.set_initial_members(
+        {p: f"a{p}" for p in peers},
+        {p: f"a{p}" for p in (non_votings or [])},
+        {p: f"a{p}" for p in (witnesses or [])},
+    )
+    return r
+
+
+class Network:
+    def __init__(self, raft_nodes: dict[int, Raft], auto_apply: bool = True) -> None:
+        self.nodes = raft_nodes
+        self.dropped: set[tuple[int, int]] = set()
+        self.isolated: set[int] = set()
+        # simulate an RSM that applies committed entries instantly, so the
+        # committed>applied campaign gate doesn't wedge harness elections
+        self.auto_apply = auto_apply
+
+    def isolate(self, rid: int) -> None:
+        self.isolated.add(rid)
+
+    def heal(self) -> None:
+        self.isolated.clear()
+        self.dropped.clear()
+
+    def drop(self, frm: int, to: int) -> None:
+        self.dropped.add((frm, to))
+
+    def _deliverable(self, m: pb.Message) -> bool:
+        if m.from_ in self.isolated or m.to in self.isolated:
+            return False
+        if (m.from_, m.to) in self.dropped:
+            return False
+        return m.to in self.nodes
+
+    def collect(self) -> list[pb.Message]:
+        out: list[pb.Message] = []
+        for r in self.nodes.values():
+            out.extend(m for m in r.msgs if not m.is_local())
+            r.msgs = []
+        return out
+
+    def _sync_applied(self) -> None:
+        if self.auto_apply:
+            for r in self.nodes.values():
+                r.applied = max(r.applied, r.log.committed)
+
+    def send(self, msgs: list[pb.Message]) -> None:
+        """Deliver messages, stepping recipients, until the network drains."""
+        queue = list(msgs)
+        while queue:
+            self._sync_applied()
+            m = queue.pop(0)
+            if self._deliverable(m):
+                self.nodes[m.to].handle(m)
+            queue.extend(self.collect())
+        self._sync_applied()
+
+    def start(self, m: pb.Message) -> None:
+        """Inject a local message at m.to and run to quiescence."""
+        self._sync_applied()
+        self.nodes[m.to].handle(m)
+        self.send(self.collect())
+
+    def elect(self, rid: int) -> None:
+        self.start(pb.Message(type=pb.MessageType.ELECTION, to=rid, from_=rid))
+
+    def propose(self, rid: int, cmd: bytes = b"data") -> None:
+        self.start(
+            pb.Message(
+                type=pb.MessageType.PROPOSE,
+                to=rid,
+                from_=rid,
+                entries=(pb.Entry(cmd=cmd),),
+            )
+        )
+
+    def tick_all(self, n: int = 1) -> None:
+        for _ in range(n):
+            self._sync_applied()
+            for r in self.nodes.values():
+                r.tick()
+            self.send(self.collect())
+
+    def leader(self) -> Raft | None:
+        leaders = [r for r in self.nodes.values() if r.state == RaftState.LEADER]
+        return leaders[0] if leaders else None
+
+
+def make_network(n: int, **kwargs) -> Network:
+    peers = list(range(1, n + 1))
+    return Network({i: new_raft(i, peers, **kwargs) for i in peers})

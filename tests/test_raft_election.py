@@ -1,0 +1,304 @@
+"""Election conformance — in the spirit of raft_etcd_test.go/raft_etcd_paper_test.go
+(tests named after the behaviors they pin, not ports of Go code)."""
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.core.pycore import RaftState
+from raft_harness import Network, make_network, new_raft
+
+MT = pb.MessageType
+
+
+def test_single_node_becomes_leader_immediately():
+    nt = make_network(1)
+    nt.elect(1)
+    assert nt.nodes[1].state == RaftState.LEADER
+    assert nt.nodes[1].term == 1
+
+
+def test_three_node_election():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    assert r1.state == RaftState.LEADER
+    assert r1.term == 1
+    for rid in (2, 3):
+        assert nt.nodes[rid].state == RaftState.FOLLOWER
+        assert nt.nodes[rid].term == 1
+        assert nt.nodes[rid].leader_id == 1
+
+
+def test_election_by_tick_timeout():
+    nt = make_network(3)
+    # node 1 has the lowest randomized timeout (rng returns 0 -> timeout = 10)
+    nt.tick_all(10)
+    assert nt.leader() is not None
+
+
+def test_candidate_votes_for_self_and_bumps_term():
+    r = new_raft(1, [1, 2, 3])
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    assert r.state == RaftState.CANDIDATE
+    assert r.term == 1
+    assert r.vote == 1
+    # sent RequestVote to both peers
+    targets = sorted(m.to for m in r.msgs if m.type == MT.REQUEST_VOTE)
+    assert targets == [2, 3]
+
+
+def test_vote_granted_once_per_term():
+    r = new_raft(1, [1, 2, 3])
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=1, log_index=0, log_term=0))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert len(resp) == 1 and not resp[0].reject
+    assert r.vote == 2
+    r.msgs = []
+    # same term, different candidate -> reject
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=3, term=1, log_index=0, log_term=0))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert len(resp) == 1 and resp[0].reject
+    # same candidate again -> grant (idempotent)
+    r.msgs = []
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=1, log_index=0, log_term=0))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert len(resp) == 1 and not resp[0].reject
+
+
+def test_vote_rejected_for_stale_log():
+    """2nd paragraph section 5.4 of the raft paper: voters reject candidates
+    with less up-to-date logs."""
+    r = new_raft(1, [1, 2, 3])
+    r.log.append([pb.Entry(term=2, index=1), pb.Entry(term=2, index=2)])
+    r.term = 2
+    # candidate with lower last log term
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=3, log_index=5, log_term=1))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert resp[0].reject
+    # candidate with equal term but shorter log
+    r.msgs = []
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=3, term=3, log_index=1, log_term=2))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert resp[0].reject
+    # candidate with same log -> grant
+    r.msgs = []
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=3, log_index=2, log_term=2))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert not resp[0].reject
+
+
+def test_candidate_steps_down_on_majority_rejection():
+    r = new_raft(1, [1, 2, 3])
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    assert r.state == RaftState.CANDIDATE
+    r.handle(pb.Message(type=MT.REQUEST_VOTE_RESP, from_=2, term=1, reject=True))
+    assert r.state == RaftState.CANDIDATE
+    r.handle(pb.Message(type=MT.REQUEST_VOTE_RESP, from_=3, term=1, reject=True))
+    assert r.state == RaftState.FOLLOWER
+
+
+def test_candidate_becomes_leader_on_quorum():
+    r = new_raft(1, [1, 2, 3, 4, 5])
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    r.handle(pb.Message(type=MT.REQUEST_VOTE_RESP, from_=2, term=1))
+    assert r.state == RaftState.CANDIDATE
+    r.handle(pb.Message(type=MT.REQUEST_VOTE_RESP, from_=3, term=1))
+    assert r.state == RaftState.LEADER
+    # noop entry appended on promotion (p72 raft thesis)
+    assert r.log.last_index() == 1
+
+
+def test_leader_appends_noop_on_election():
+    nt = make_network(3)
+    nt.elect(1)
+    leader = nt.nodes[1]
+    assert leader.log.last_index() == 1
+    assert leader.log.committed == 1  # replicated to followers during drain
+    assert nt.nodes[2].log.last_index() == 1
+
+
+def test_higher_term_message_converts_to_follower():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    # bogus higher-term heartbeat: r1 must step down
+    r1.handle(pb.Message(type=MT.HEARTBEAT, from_=3, term=5))
+    assert r1.state == RaftState.FOLLOWER
+    assert r1.term == 5
+    assert r1.leader_id == 3
+
+
+def test_lower_term_message_ignored():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    before = r1.term
+    r1.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=0))
+    assert r1.state == RaftState.LEADER and r1.term == before
+
+
+def test_disrupted_node_campaign_bumps_cluster_term():
+    nt = make_network(3)
+    nt.elect(1)
+    nt.isolate(3)
+    # node 3 times out repeatedly and self-campaigns twice
+    nt.nodes[3].handle(pb.Message(type=MT.ELECTION, from_=3))
+    nt.nodes[3].handle(pb.Message(type=MT.ELECTION, from_=3))
+    nt.nodes[3].msgs = []
+    assert nt.nodes[3].term == 3
+    nt.heal()
+    # when it rejoins with a RequestVote at higher term, leader steps down
+    # (no checkQuorum lease protection in this config)
+    nt.start(pb.Message(type=MT.ELECTION, to=3, from_=3))
+    assert nt.nodes[1].term == nt.nodes[3].term
+
+
+def test_check_quorum_lease_drops_high_term_request_vote():
+    """Last paragraph of section 6 (raft paper): servers disregard RequestVote
+    when they believe a current leader exists within election timeout."""
+    nt = make_network(3, check_quorum=True)
+    nt.elect(1)
+    # follower 2 recently heard from the leader
+    r2 = nt.nodes[2]
+    r2.handle(pb.Message(type=MT.REQUEST_VOTE, from_=3, term=99, log_index=99, log_term=99))
+    assert r2.term == 1  # dropped, no term bump
+    assert not any(m.type == MT.REQUEST_VOTE_RESP for m in r2.msgs)
+
+
+def test_check_quorum_lease_allows_vote_with_transfer_hint():
+    """p42 raft thesis: leadership-transfer campaigns carry the candidate id
+    as hint and bypass the lease."""
+    nt = make_network(3, check_quorum=True)
+    nt.elect(1)
+    r2 = nt.nodes[2]
+    r2.handle(
+        pb.Message(
+            type=MT.REQUEST_VOTE, from_=3, term=2, log_index=1, log_term=1, hint=3
+        )
+    )
+    assert r2.term == 2
+
+
+def test_leader_steps_down_without_quorum():
+    nt = make_network(3, check_quorum=True)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    assert r1.state == RaftState.LEADER
+    nt.isolate(2)
+    nt.isolate(3)
+    # two election timeouts with no responses -> leader loses quorum
+    for _ in range(2 * r1.election_timeout):
+        r1.tick()
+    r1.msgs = []
+    assert r1.state == RaftState.FOLLOWER
+
+
+def test_prevote_isolated_node_does_not_bump_term():
+    """Pre-vote alone keeps the partitioned node's term from growing; on
+    rejoin the election happens at term+1 (one step), not term+N."""
+    nt = make_network(3, pre_vote=True)
+    nt.elect(1)
+    assert nt.nodes[1].state == RaftState.LEADER
+    term_before = nt.nodes[1].term
+    nt.isolate(3)
+    for _ in range(5):
+        nt.nodes[3].handle(pb.Message(type=MT.ELECTION, from_=3))
+        nt.nodes[3].msgs = []
+    assert nt.nodes[3].term == term_before
+    assert nt.nodes[3].state == RaftState.PRE_VOTE_CANDIDATE
+    nt.heal()
+    nt.start(pb.Message(type=MT.ELECTION, to=3, from_=3))
+    assert nt.leader() is not None
+    assert nt.leader().term == term_before + 1
+
+
+def test_prevote_with_check_quorum_blocks_disruption():
+    """The full non-disruption guarantee: pre-vote + check-quorum lease.
+    A rejoining node's RequestPreVote is dropped by lease holders
+    (raft.go:1507 dropRequestVoteFromHighTermNode covers pre-votes too)."""
+    nt = make_network(3, pre_vote=True, check_quorum=True)
+    nt.elect(1)
+    term_before = nt.nodes[1].term
+    nt.isolate(3)
+    for _ in range(5):
+        nt.nodes[3].handle(pb.Message(type=MT.ELECTION, from_=3))
+        nt.nodes[3].msgs = []
+    nt.heal()
+    nt.start(pb.Message(type=MT.ELECTION, to=3, from_=3))
+    assert nt.nodes[1].state == RaftState.LEADER
+    assert nt.nodes[1].term == term_before
+    assert nt.nodes[3].state == RaftState.PRE_VOTE_CANDIDATE
+
+
+def test_prevote_election_succeeds_cluster_wide():
+    nt = make_network(3, pre_vote=True)
+    nt.elect(2)
+    assert nt.nodes[2].state == RaftState.LEADER
+    assert nt.nodes[2].term == 1
+
+
+def test_prevote_candidate_state_and_no_term_change_on_reject():
+    r = new_raft(1, [1, 2, 3], pre_vote=True)
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    assert r.state == RaftState.PRE_VOTE_CANDIDATE
+    assert r.term == 0
+    reqs = [m for m in r.msgs if m.type == MT.REQUEST_PREVOTE]
+    assert len(reqs) == 2 and all(m.term == 1 for m in reqs)
+    r.handle(pb.Message(type=MT.REQUEST_PREVOTE_RESP, from_=2, term=0, reject=True))
+    r.handle(pb.Message(type=MT.REQUEST_PREVOTE_RESP, from_=3, term=0, reject=True))
+    assert r.state == RaftState.FOLLOWER
+    assert r.term == 0
+
+
+def test_prevote_quorum_starts_real_campaign():
+    r = new_raft(1, [1, 2, 3], pre_vote=True)
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    r.handle(pb.Message(type=MT.REQUEST_PREVOTE_RESP, from_=2, term=1))
+    assert r.state == RaftState.CANDIDATE
+    assert r.term == 1
+
+
+def test_non_voting_never_campaigns():
+    r = new_raft(4, [1, 2, 3], non_votings=[4], is_non_voting=True)
+    for _ in range(100):
+        r.tick()
+    assert r.state == RaftState.NON_VOTING
+    assert not any(m.type == MT.REQUEST_VOTE for m in r.msgs)
+
+
+def test_witness_never_campaigns_but_votes():
+    r = new_raft(4, [1, 2, 3], witnesses=[4], is_witness=True)
+    for _ in range(100):
+        r.tick()
+    assert r.state == RaftState.WITNESS
+    r.handle(pb.Message(type=MT.REQUEST_VOTE, from_=2, term=3, log_index=0, log_term=0))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP]
+    assert len(resp) == 1 and not resp[0].reject
+
+
+def test_randomized_timeout_in_range():
+    import random
+
+    r = new_raft(1, [1, 2, 3], election=10, rng=lambda n: random.randrange(n))
+    seen = set()
+    for _ in range(200):
+        r.set_randomized_election_timeout()
+        seen.add(r.randomized_election_timeout)
+        assert 10 <= r.randomized_election_timeout < 20
+    assert len(seen) > 3
+
+
+def test_election_skipped_with_unapplied_committed_entries():
+    """raft.go:1632-1645: campaigns are skipped while config changes may be
+    committed-but-unapplied (conservative committed>applied check)."""
+    nt = make_network(3)
+    nt.auto_apply = False
+    nt.elect(1)
+    nt.propose(1)
+    r2 = nt.nodes[2]
+    assert r2.log.committed > r2.applied
+    r2.handle(pb.Message(type=MT.ELECTION, from_=2))
+    assert r2.state == RaftState.FOLLOWER  # campaign skipped
+    r2.applied = r2.log.committed
+    r2.handle(pb.Message(type=MT.ELECTION, from_=2))
+    assert r2.state == RaftState.CANDIDATE

@@ -1,0 +1,218 @@
+"""Membership change + snapshot-restore conformance (§3.5 of the survey;
+one-at-a-time config changes per p33-35 of the raft thesis)."""
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.core.pycore import RaftState
+from raft_harness import Network, make_network, new_raft
+
+MT = pb.MessageType
+
+
+def cc_event(rid, cctype):
+    return pb.Message(
+        type=MT.CONFIG_CHANGE_EVENT, hint=rid, hint_high=int(cctype)
+    )
+
+
+def test_add_node_expands_membership():
+    r = new_raft(1, [1, 2])
+    r.handle(cc_event(3, pb.ConfigChangeType.ADD_NODE))
+    assert sorted(r.remotes) == [1, 2, 3]
+    assert r.quorum() == 2
+    assert r.remotes[3].next == r.log.last_index() + 1
+
+
+def test_remove_node_shrinks_membership():
+    r = new_raft(1, [1, 2, 3])
+    r.handle(cc_event(3, pb.ConfigChangeType.REMOVE_NODE))
+    assert sorted(r.remotes) == [1, 2]
+    assert r.quorum() == 2
+
+
+def test_removed_leader_steps_down():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    r1.handle(cc_event(1, pb.ConfigChangeType.REMOVE_NODE))
+    assert r1.state == RaftState.FOLLOWER
+    assert 1 not in r1.remotes
+
+
+def test_removal_can_advance_commit():
+    """Removing a lagging member may unblock commit (raft.go:1294-1298)."""
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    nt.isolate(3)
+    nt.isolate(2)
+    nt.propose(1, b"x")
+    assert r1.log.committed == 1
+    # removing one unreachable member turns quorum into 2-of-2... still no.
+    # removing reduces to 2 members (1,2): match of 2 is 1. no commit.
+    r1.handle(cc_event(3, pb.ConfigChangeType.REMOVE_NODE))
+    assert r1.log.committed == 1
+    # now node 2's ack arrives (heal + heartbeat round)
+    nt.heal()
+    nt.start(pb.Message(type=MT.LEADER_HEARTBEAT, to=1, from_=1))
+    assert r1.log.committed == r1.log.last_index()
+
+
+def test_one_config_change_at_a_time():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    cc1 = pb.Entry(type=pb.EntryType.CONFIG_CHANGE, cmd=b"cc1")
+    r1.handle(pb.Message(type=MT.PROPOSE, from_=1, entries=(cc1,)))
+    assert r1.pending_config_change
+    # second CC while one is pending is replaced by a noop and reported dropped
+    cc2 = pb.Entry(type=pb.EntryType.CONFIG_CHANGE, cmd=b"cc2")
+    r1.handle(pb.Message(type=MT.PROPOSE, from_=1, entries=(cc2,)))
+    assert r1.dropped_entries and r1.dropped_entries[0].cmd == b"cc2"
+    ents = r1.log.get_entries(1, r1.log.last_index() + 1)
+    assert sum(1 for e in ents if e.type == pb.EntryType.CONFIG_CHANGE) == 1
+    # applying the CC clears the flag
+    r1.handle(cc_event(4, pb.ConfigChangeType.ADD_NODE))
+    assert not r1.pending_config_change
+
+
+def test_rejected_config_change_clears_flag():
+    nt = make_network(3)
+    nt.elect(1)
+    r1 = nt.nodes[1]
+    r1.handle(
+        pb.Message(
+            type=MT.PROPOSE, from_=1,
+            entries=(pb.Entry(type=pb.EntryType.CONFIG_CHANGE, cmd=b"cc"),),
+        )
+    )
+    assert r1.pending_config_change
+    r1.handle(pb.Message(type=MT.CONFIG_CHANGE_EVENT, reject=True))
+    assert not r1.pending_config_change
+
+
+def test_become_leader_restores_pending_cc_flag():
+    """A new leader with an uncommitted CC entry in its log must restore the
+    pending flag (raft.go:1075 preLeaderPromotionHandleConfigChange)."""
+    r = new_raft(1, [1, 2, 3])
+    r.term = 1
+    # follower receives a CC entry it hasn't applied
+    r.handle(
+        pb.Message(
+            type=MT.REPLICATE, from_=2, term=1, log_index=0, log_term=0,
+            entries=(pb.Entry(term=1, index=1, type=pb.EntryType.CONFIG_CHANGE),),
+        )
+    )
+    # let the campaign gate pass (committed entries treated as applied)
+    r.applied = r.log.committed
+    r.handle(pb.Message(type=MT.ELECTION, from_=1))
+    r.handle(pb.Message(type=MT.REQUEST_VOTE_RESP, from_=2, term=2))
+    assert r.state == RaftState.LEADER
+    assert r.pending_config_change
+
+
+def test_promote_nonvoting_to_voter():
+    nt = Network(
+        {
+            1: new_raft(1, [1, 2], non_votings=[3]),
+            2: new_raft(2, [1, 2], non_votings=[3]),
+            3: new_raft(3, [1, 2], non_votings=[3], is_non_voting=True),
+        }
+    )
+    nt.elect(1)
+    nt.propose(1, b"x")
+    r1, r3 = nt.nodes[1], nt.nodes[3]
+    match_before = r1.non_votings[3].match
+    assert match_before == r1.log.last_index()  # nonvoting keeps up
+    for r in nt.nodes.values():
+        r.handle(cc_event(3, pb.ConfigChangeType.ADD_NODE))
+    assert 3 in r1.remotes and 3 not in r1.non_votings
+    # progress inherited on promotion (raft.go:1246-1252)
+    assert r1.remotes[3].match == match_before
+    assert r3.state == RaftState.FOLLOWER
+    assert r1.quorum() == 2
+
+
+def test_add_witness():
+    r = new_raft(1, [1, 2])
+    r.handle(cc_event(3, pb.ConfigChangeType.ADD_WITNESS))
+    assert 3 in r.witnesses
+    assert r.num_voting_members() == 3
+    assert r.quorum() == 2
+
+
+def test_snapshot_restore_follower():
+    r = new_raft(2, [1, 2, 3])
+    r.term = 2
+    ss = pb.Snapshot(
+        index=10,
+        term=2,
+        membership=pb.Membership(
+            config_change_id=5, addresses={1: "a1", 2: "a2", 4: "a4"}
+        ),
+    )
+    r.handle(pb.Message(type=MT.INSTALL_SNAPSHOT, from_=1, term=2, snapshot=ss))
+    assert r.log.committed == 10
+    assert r.log.last_index() == 10
+    assert r.log.term(10) == 2
+    assert sorted(r.remotes) == [1, 2, 4]
+    resp = [m for m in r.msgs if m.type == MT.REPLICATE_RESP]
+    assert resp and resp[0].log_index == 10
+
+
+def test_snapshot_restore_ignored_when_stale():
+    r = new_raft(2, [1, 2, 3])
+    r.term = 2
+    # local log already committed past the snapshot
+    r.handle(
+        pb.Message(
+            type=MT.REPLICATE, from_=1, term=2, log_index=0, log_term=0,
+            entries=tuple(pb.Entry(term=2, index=i) for i in range(1, 6)),
+            commit=5,
+        )
+    )
+    assert r.log.committed == 5
+    r.msgs = []
+    ss = pb.Snapshot(index=3, term=2, membership=pb.Membership(addresses={1: "a"}))
+    r.handle(pb.Message(type=MT.INSTALL_SNAPSHOT, from_=1, term=2, snapshot=ss))
+    # stale snapshot rejected; responds with committed index
+    resp = [m for m in r.msgs if m.type == MT.REPLICATE_RESP]
+    assert resp and resp[0].log_index == 5
+    assert r.log.last_index() == 5
+
+
+def test_snapshot_covered_by_matching_log_fast_forwards_commit():
+    r = new_raft(2, [1, 2, 3])
+    r.term = 2
+    r.handle(
+        pb.Message(
+            type=MT.REPLICATE, from_=1, term=2, log_index=0, log_term=0,
+            entries=tuple(pb.Entry(term=2, index=i) for i in range(1, 6)),
+            commit=1,
+        )
+    )
+    assert r.log.committed == 1
+    ss = pb.Snapshot(index=4, term=2, membership=pb.Membership(addresses={1: "a"}))
+    r.msgs = []
+    r.handle(pb.Message(type=MT.INSTALL_SNAPSHOT, from_=1, term=2, snapshot=ss))
+    # log matches snapshot: no restore, but commit fast-forwarded
+    assert r.log.committed == 4
+    assert r.log.last_index() == 5  # log kept
+
+
+def test_bootstrap_via_peer_launch():
+    from dragonboat_tpu.core.logentry import InMemoryLogDB
+    from dragonboat_tpu.core.peer import Peer
+    from dragonboat_tpu.core.pycore import CoreConfig
+
+    cfg = CoreConfig(shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1)
+    p = Peer.launch(cfg, InMemoryLogDB(), {1: "a1", 2: "a2", 3: "a3"},
+                    initial=True, new_node=True, rng=lambda n: 0)
+    r = p.raft
+    assert sorted(r.remotes) == [1, 2, 3]
+    assert r.log.last_index() == 3
+    assert r.log.committed == 3
+    ents = r.log.get_entries(1, 4)
+    assert all(e.type == pb.EntryType.CONFIG_CHANGE for e in ents)
+    ccs = [pb.decode_config_change(e.cmd) for e in ents]
+    assert [c.replica_id for c in ccs] == [1, 2, 3]
+    assert all(c.initialize for c in ccs)
