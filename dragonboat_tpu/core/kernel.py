@@ -1,0 +1,921 @@
+"""The batched Raft step kernel.
+
+One jitted call advances **every shard one step**: drain the inbox lanes,
+serve the batched ReadIndex request, append proposals, apply the transfer
+request, tick the logical clock, then materialize one coalesced send phase
+(≤1 Replicate + ≤1 Heartbeat per peer per step).  This is the TPU-first
+re-expression of the reference's per-goroutine step loop
+(``engine.go:1230 stepWorkerMain`` → ``node.go:1161 handleEvents``): the
+scheduler becomes a vmap axis, the per-message sends become end-of-step
+lanes, and the handler matrix (``raft.go:2332``) becomes masked updates —
+under vmap every branch runs for every shard, so the code is written
+branchless from the start.
+
+Semantics parity is with :mod:`dragonboat_tpu.core.pycore` (itself cited
+against ``/root/reference/internal/raft/raft.go``); the differential suite in
+``tests/test_kernel_differential.py`` drives both on identical inputs.
+
+Control-flow divergences from the reference (documented, behavior-safe):
+
+- sends are coalesced per step; the content of a Replicate reflects
+  end-of-step flow-control state rather than mid-step snapshots;
+- proposals and reads are host-routed to the leader replica, so follower
+  redirect paths never execute on device;
+- InstallSnapshot / ConfigChangeEvent / LogQuery are host-mediated through
+  the pycore slow path (SURVEY §7 "masked slow path");
+- entry payloads are not on device: the ring stores terms + config-change
+  markers, the host mirrors payloads keyed by (shard, index).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.core import params as P
+from dragonboat_tpu.core.kstate import (
+    Inbox,
+    ShardState,
+    StepInput,
+    StepOutput,
+)
+
+I32 = jnp.int32
+INT_MAX = jnp.iinfo(jnp.int32).max
+MT = pb.MessageType
+
+
+def sel(c, a, b):
+    return jnp.where(c, a, b)
+
+
+def mrep(s: ShardState, mask, **kw) -> ShardState:
+    """Masked replace: set fields where mask (scalar bool) holds."""
+    upd = {}
+    for k, v in kw.items():
+        old = getattr(s, k)
+        upd[k] = jnp.where(mask, v, old)
+    return s._replace(**upd)
+
+
+class Effects(NamedTuple):
+    """Step-local accumulator consumed by the send phase."""
+
+    need_rep: jnp.ndarray       # [P] bool
+    need_hb: jnp.ndarray        # bool
+    hb_low: jnp.ndarray
+    hb_high: jnp.ndarray
+    send_vote: jnp.ndarray      # 0 none / 1 RequestVote / 2 RequestPreVote
+    vote_hint: jnp.ndarray
+    send_tn: jnp.ndarray        # [P] bool — TimeoutNow
+    rtr_valid: jnp.ndarray      # [RI]
+    rtr_index: jnp.ndarray
+    rtr_low: jnp.ndarray
+    rtr_high: jnp.ndarray
+    rtr_n: jnp.ndarray
+    save_from: jnp.ndarray      # min appended/truncated index this step
+    ri_dropped: jnp.ndarray
+
+
+def _empty_effects(kp: P.KernelParams) -> Effects:
+    Pn, RI = kp.num_peers, kp.readindex_cap
+    z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+    zb = lambda *s: jnp.zeros(s, bool)  # noqa: E731
+    return Effects(
+        need_rep=zb(Pn), need_hb=zb(), hb_low=z(), hb_high=z(),
+        send_vote=z(), vote_hint=z(), send_tn=zb(Pn),
+        rtr_valid=zb(RI), rtr_index=z(RI), rtr_low=z(RI), rtr_high=z(RI),
+        rtr_n=z(), save_from=jnp.asarray(INT_MAX, I32), ri_dropped=zb(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# log-ring helpers (two-tier view collapsed to ring + snapshot floor;
+# parity logentry.go:97-156 term resolution)
+# ---------------------------------------------------------------------------
+
+
+def _slot(kp: P.KernelParams, idx):
+    return idx & (kp.log_cap - 1)
+
+
+def log_term_at(kp: P.KernelParams, s: ShardState, idx):
+    """(term, compacted, unavailable) for index idx."""
+    in_ring = (idx > s.snap_index) & (idx <= s.last)
+    t = sel(
+        idx == 0,
+        0,
+        sel(idx == s.snap_index, s.snap_term,
+            sel(in_ring, s.lt[_slot(kp, idx)], 0)),
+    )
+    compacted = idx < s.snap_index
+    unavailable = idx > s.last
+    return t, compacted, unavailable
+
+
+def match_term(kp, s, idx, term):
+    t, comp, unav = log_term_at(kp, s, idx)
+    return (~comp) & (~unav) & (t == term)
+
+
+def up_to_date(kp, s, idx, term):
+    lt_last, _, _ = log_term_at(kp, s, s.last)
+    return (term > lt_last) | ((term == lt_last) & (idx >= s.last))
+
+
+def _cc_count_in(kp: P.KernelParams, s: ShardState, lo, hi):
+    """Count config-change entries with index in (lo, hi] — used to restore
+    the pending flag on promotion (raft.go:1075)."""
+    j = jnp.arange(kp.log_cap, dtype=I32)
+    idx = s.last - ((s.last - j) & (kp.log_cap - 1))
+    live = (idx > lo) & (idx <= hi) & (idx > s.snap_index)
+    return jnp.sum(sel(live & s.lcc, 1, 0).astype(I32))
+
+
+# ---------------------------------------------------------------------------
+# peer-book helpers (parity remote.go)
+# ---------------------------------------------------------------------------
+
+
+def _self_slot_mask(s: ShardState):
+    return (s.pid == s.replica_id) & (s.kind != P.K_ABSENT)
+
+
+def _voting_mask(s: ShardState):
+    return (s.kind == P.K_VOTER) | (s.kind == P.K_WITNESS)
+
+
+def _num_voting(s: ShardState):
+    return jnp.sum(_voting_mask(s).astype(I32))
+
+
+def _quorum(s: ShardState):
+    return _num_voting(s) // 2 + 1
+
+
+def _is_single_node(s: ShardState):
+    return _quorum(s) == 1
+
+
+def _self_removed(s: ShardState):
+    return ~jnp.any(_self_slot_mask(s))
+
+
+def _sorted_match_quorum_index(s: ShardState):
+    """The q-th largest match among voting members — the batched
+    tryCommit's jnp.sort (mirrors raft.go:911-941 sortMatchValues)."""
+    mv = sel(_voting_mask(s), s.match, INT_MAX)
+    srt = jnp.sort(mv)  # ascending; absent lanes sort to the end
+    nv = _num_voting(s)
+    pos = jnp.clip(nv - _quorum(s), 0, s.match.shape[0] - 1)
+    return srt[pos]
+
+
+def _try_commit(kp, s: ShardState) -> ShardState:
+    q = _sorted_match_quorum_index(s)
+    t, comp, _ = log_term_at(kp, s, q)
+    t = sel(comp, 0, t)
+    ok = (q > s.committed) & (t == s.term) & (s.role == P.LEADER)
+    return mrep(s, ok, committed=q)
+
+
+# ---------------------------------------------------------------------------
+# state transitions (parity raft.go:960-1130)
+# ---------------------------------------------------------------------------
+
+
+def _next_rand_timeout(s: ShardState):
+    counter = s.rand_counter + 1
+    mixed = P.splitmix32(
+        (s.seed.astype(jnp.uint32) ^ (counter.astype(jnp.uint32) * jnp.uint32(0x632BE5AB)))
+    )
+    r = (mixed % s.e_timeout.astype(jnp.uint32)).astype(I32)
+    return counter, s.e_timeout + r
+
+
+def _reset(s: ShardState, mask, term, reset_timeout) -> ShardState:
+    """Shared reset on every role transition (raft.go:1052 reset)."""
+    term_changed = s.term != term
+    counter, rand_t = _next_rand_timeout(s)
+    self_mask = _self_slot_mask(s)
+    s = mrep(
+        s, mask,
+        term=term,
+        vote=sel(term_changed, 0, s.vote),
+        e_tick=sel(reset_timeout, 0, s.e_tick),
+        rand_counter=sel(reset_timeout, counter, s.rand_counter),
+        rand_timeout=sel(reset_timeout, rand_t, s.rand_timeout),
+        h_tick=0,
+        pending_cc=False,
+        ltt=0,
+        vresp=jnp.zeros_like(s.vresp),
+        vgrant=jnp.zeros_like(s.vgrant),
+        match=sel(self_mask, s.last, 0),
+        next=jnp.full_like(s.next, 1) * (s.last + 1),
+        pstate=jnp.zeros_like(s.pstate),
+        active=jnp.zeros_like(s.active),
+        psnap=jnp.zeros_like(s.psnap),
+        ri_head=0,
+        ri_count=0,
+        ri_acks=jnp.zeros_like(s.ri_acks),
+    )
+    return s
+
+
+def _become_follower(s, mask, term, leader, reset_timeout=True):
+    # witnesses/non-votings keep their role on term bumps (raft.go:972-990)
+    new_role = sel(
+        s.role == P.NON_VOTING, P.NON_VOTING,
+        sel(s.role == P.WITNESS, P.WITNESS, P.FOLLOWER),
+    )
+    s = _reset(s, mask, sel(mask, term, s.term), reset_timeout & mask)
+    return mrep(s, mask, role=new_role, leader=leader)
+
+
+def _append_one(kp, s: ShardState, mask, term, is_cc) -> ShardState:
+    idx = s.last + 1
+    slot = _slot(kp, idx)
+    lt = s.lt.at[slot].set(sel(mask, term, s.lt[slot]))
+    lcc = s.lcc.at[slot].set(sel(mask, is_cc, s.lcc[slot]))
+    s = s._replace(lt=lt, lcc=lcc)
+    return mrep(s, mask, last=idx)
+
+
+def _become_leader(kp, s: ShardState, mask, eff: Effects):
+    """Candidate→leader: reset, restore pending-CC flag, append noop
+    (p72 raft thesis), broadcast (raft.go:1038)."""
+    s2 = _reset(s, mask, s.term, True)
+    s2 = mrep(s2, mask, role=P.LEADER, leader=s.replica_id)
+    cc_pending = _cc_count_in(kp, s2, s2.committed, s2.last) > 0
+    s2 = mrep(s2, mask, pending_cc=cc_pending)
+    s2 = _append_one(kp, s2, mask, s2.term, False)
+    self_mask = _self_slot_mask(s2)
+    s2 = s2._replace(
+        match=sel(mask & self_mask, s2.last, s2.match),
+        next=sel(mask & self_mask, s2.last + 1, s2.next),
+    )
+    s2 = _try_commit(kp, s2)
+    eff = eff._replace(
+        need_rep=sel(mask, jnp.ones_like(eff.need_rep), eff.need_rep),
+        save_from=sel(mask, jnp.minimum(eff.save_from, s2.last), eff.save_from),
+    )
+    return s2, eff
+
+
+def _campaign(kp, s: ShardState, eff: Effects, mask, allow_prevote=True):
+    """Election entry — handleNodeElection (raft.go:1632): pre-vote campaign
+    unless transferring; single-node fast paths to leader."""
+    gate = s.committed > s.applied  # conservative config-change gate
+    mask = mask & ~gate & ~_self_removed(s)
+    use_prevote = s.pre_vote & ~s.is_ltt & allow_prevote
+    single = _is_single_node(s)
+
+    # -- pre-vote branch: no term bump (raft.go:1149 preVoteCampaign)
+    pv = mask & use_prevote
+    s = _reset(s, pv, s.term, True)
+    s = mrep(s, pv, role=P.PRE_VOTE_CANDIDATE, leader=0)
+    self_mask = _self_slot_mask(s)
+    s = s._replace(
+        vresp=sel(pv & self_mask, True, s.vresp),
+        vgrant=sel(pv & self_mask, True, s.vgrant),
+    )
+    eff = eff._replace(send_vote=sel(pv & ~single, 2, eff.send_vote))
+
+    # -- real campaign branch (raft.go:1176 campaign)
+    rc = mask & (~use_prevote | single)
+    hint = sel(s.is_ltt, s.replica_id, 0)
+    s = _reset(s, rc, s.term + 1, True)
+    s = mrep(s, rc, role=P.CANDIDATE, leader=0, vote=s.replica_id,
+             is_ltt=False)
+    self_mask = _self_slot_mask(s)
+    s = s._replace(
+        vresp=sel(rc & self_mask, True, s.vresp),
+        vgrant=sel(rc & self_mask, True, s.vgrant),
+    )
+    eff = eff._replace(
+        send_vote=sel(rc & ~single, 1, eff.send_vote),
+        vote_hint=sel(rc & ~single, hint, eff.vote_hint),
+    )
+    s2, eff = _become_leader(kp, s, rc & single, eff)
+    return s2, eff
+
+
+# ---------------------------------------------------------------------------
+# readindex book (parity readindex.go)
+# ---------------------------------------------------------------------------
+
+
+def _ri_push(kp, s: ShardState, mask, low, high, index):
+    RI = kp.readindex_cap
+    full = s.ri_count >= RI
+    pos = (s.ri_head + s.ri_count) & (RI - 1)
+    do = mask & ~full
+    s = s._replace(
+        ri_low=s.ri_low.at[pos].set(sel(do, low, s.ri_low[pos])),
+        ri_high=s.ri_high.at[pos].set(sel(do, high, s.ri_high[pos])),
+        ri_index=s.ri_index.at[pos].set(sel(do, index, s.ri_index[pos])),
+        ri_acks=s.ri_acks.at[pos].set(
+            sel(do, jnp.zeros_like(s.ri_acks[pos]), s.ri_acks[pos])
+        ),
+    )
+    s = mrep(s, do, ri_count=s.ri_count + 1)
+    # a full book drops the request (host will retry) — bounded-memory analog
+    # of the reference's unbounded pending map
+    return s, mask & full
+
+
+def _ri_confirm(kp, s: ShardState, eff: Effects, mask, low, high, sender_slot):
+    """Ack ctx from sender; pop every ctx at-or-before once quorum reached
+    (readindex.go:73 confirm)."""
+    RI = kp.readindex_cap
+    arange = jnp.arange(RI, dtype=I32)
+    # queue position of each physical slot (0..count-1), INT_MAX if dead
+    qpos = (arange - s.ri_head) & (RI - 1)
+    live = qpos < s.ri_count
+    hit = live & (s.ri_low == low) & (s.ri_high == high)
+    hit_any = mask & jnp.any(hit)
+    hit_slot = jnp.argmax(hit)
+    acks = s.ri_acks.at[hit_slot, sender_slot].set(
+        sel(hit_any, True, s.ri_acks[hit_slot, sender_slot])
+    )
+    s = s._replace(ri_acks=acks)
+    n_acks = jnp.sum(s.ri_acks[hit_slot].astype(I32))
+    quorum_ok = hit_any & (n_acks + 1 >= _quorum(s))
+    pop_n = sel(quorum_ok, qpos[hit_slot] + 1, 0)
+    # pop: emit rtr for queue positions < pop_n
+    popping = live & (qpos < pop_n)
+    base = eff.rtr_n
+    out_pos = base + qpos  # each popped ctx goes to rtr lane base+qpos
+    # scatter via explicit loop over RI lanes (RI is small)
+    rv, ri_, rl, rh = eff.rtr_valid, eff.rtr_index, eff.rtr_low, eff.rtr_high
+    for j in range(RI):
+        src = popping & (out_pos == j)
+        any_src = jnp.any(src)
+        k = jnp.argmax(src)
+        rv = rv.at[j].set(sel(any_src, True, rv[j]))
+        ri_ = ri_.at[j].set(sel(any_src, s.ri_index[k], ri_[j]))
+        rl = rl.at[j].set(sel(any_src, s.ri_low[k], rl[j]))
+        rh = rh.at[j].set(sel(any_src, s.ri_high[k], rh[j]))
+    eff = eff._replace(
+        rtr_valid=rv, rtr_index=ri_, rtr_low=rl, rtr_high=rh,
+        rtr_n=base + pop_n,
+    )
+    s = mrep(s, pop_n > 0,
+             ri_head=(s.ri_head + pop_n) & (RI - 1),
+             ri_count=s.ri_count - pop_n)
+    return s, eff
+
+
+# ---------------------------------------------------------------------------
+# the per-message processor (scan body over K inbox slots)
+# ---------------------------------------------------------------------------
+
+
+def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
+    """One inbound message against one shard — masked analog of
+    raft.Handle (raft.go:1596) for the kernel-resident message set."""
+    E = kp.msg_entries
+    valid = m.from_ != 0
+    mtype = m.mtype
+
+    slot_hit = (s.pid == m.from_) & (s.kind != P.K_ABSENT)
+    sender_known = jnp.any(slot_hit)
+    sender_slot = jnp.argmax(slot_hit)
+
+    is_rv_msg = (mtype == MT.REQUEST_VOTE) | (mtype == MT.REQUEST_PREVOTE)
+    is_leader_msg = (
+        (mtype == MT.REPLICATE)
+        | (mtype == MT.HEARTBEAT)
+        | (mtype == MT.TIMEOUT_NOW)
+        | (mtype == MT.READ_INDEX_RESP)
+    )
+
+    # ---- term preamble (raft.go:1540 onMessageTermNotMatched) ----
+    drop_rv = (
+        valid & is_rv_msg & s.check_quorum & (m.term > s.term)
+        & (m.hint != m.from_)
+        & (s.leader != 0) & (s.e_tick < s.e_timeout)
+    )
+    higher = valid & (m.term > s.term) & ~drop_rv
+    prevote_expected = (mtype == MT.REQUEST_PREVOTE) | (
+        (mtype == MT.REQUEST_PREVOTE_RESP) & ~m.reject
+    )
+    bump = higher & ~prevote_expected
+    new_leader = sel(is_leader_msg, m.from_, 0)
+    keep_tick = mtype == MT.REQUEST_VOTE
+    s = _become_follower(s, bump, m.term, new_leader, reset_timeout=~keep_tick)
+
+    lower = valid & (m.term < s.term) & (m.term != 0)
+    # free-stuck-candidate NoOP (raft.go:1582-1589)
+    noop_reply = lower & (
+        (mtype == MT.REQUEST_PREVOTE)
+        | (is_leader_msg & (s.check_quorum | s.pre_vote))
+    )
+    ignore = drop_rv | lower
+
+    act = valid & ~ignore
+    is_leader = s.role == P.LEADER
+    is_candidate = (s.role == P.CANDIDATE) | (s.role == P.PRE_VOTE_CANDIDATE)
+    is_follower_like = (
+        (s.role == P.FOLLOWER) | (s.role == P.NON_VOTING) | (s.role == P.WITNESS)
+    )
+
+    # candidate + same-term leader message → become follower (raft.go:2218)
+    cand_fold = act & is_candidate & is_leader_msg & (
+        (mtype == MT.REPLICATE) | (mtype == MT.HEARTBEAT)
+    )
+    s = _become_follower(s, cand_fold, s.term, m.from_)
+    is_follower_like = is_follower_like | cand_fold
+
+    # response accumulator for this message
+    r_type = jnp.asarray(0, I32)
+    r_to = m.from_
+    r_term = s.term
+    r_log_index = jnp.asarray(0, I32)
+    r_reject = jnp.asarray(False)
+    r_hint = jnp.asarray(0, I32)
+    r_hint_high = jnp.asarray(0, I32)
+
+    r_type = sel(noop_reply, MT.NOOP, r_type)
+
+    # ---- Replicate (follower-side; raft.go:1444 handleReplicateMessage) ----
+    h_rep = act & is_follower_like & (mtype == MT.REPLICATE)
+    s = mrep(s, h_rep, leader=m.from_, e_tick=0)
+    below_commit = m.log_index < s.committed
+    prev_ok = match_term(kp, s, m.log_index, m.log_term)
+    # ring-capacity guard: never let the append run past the term ring —
+    # reject instead (the leader backs off; the host drives compaction /
+    # snapshot install through the slow path). Keeps the invariant
+    # last - snap_index <= log_cap so ring slots never alias.
+    over_cap = (m.log_index + m.n_ent - s.snap_index) > kp.log_cap
+    accept = h_rep & ~below_commit & prev_ok & ~over_cap
+    s = mrep(s, h_rep & over_cap, needs_host=True)
+    # conflict scan over the E entry lanes
+    ent_idx = m.log_index + 1 + jnp.arange(E, dtype=I32)
+    ent_live = jnp.arange(E, dtype=I32) < m.n_ent
+    ent_match = jax.vmap(lambda i, t: match_term(kp, s, i, t))(ent_idx, m.ent_term)
+    conflict_lane = ent_live & ~ent_match
+    any_conflict = jnp.any(conflict_lane)
+    first_conflict = jnp.argmax(conflict_lane)  # lane index
+    # append entries from the first conflicting lane on
+    do_append = accept & any_conflict
+    append_from_lane = first_conflict
+    # ring writes for lanes >= first_conflict (and live)
+    write_lane = ent_live & (jnp.arange(E, dtype=I32) >= append_from_lane)
+    widx = ent_idx
+    wslot = _slot(kp, widx)
+    wmask = do_append & write_lane
+    s = s._replace(
+        lt=s.lt.at[wslot].set(sel(wmask, m.ent_term, s.lt[wslot])),
+        lcc=s.lcc.at[wslot].set(sel(wmask, m.ent_cc, s.lcc[wslot])),
+    )
+    new_last_if_append = m.log_index + m.n_ent
+    s = mrep(s, do_append, last=new_last_if_append,
+             stable=jnp.minimum(s.stable, m.log_index + append_from_lane))
+    eff = eff._replace(
+        save_from=sel(
+            do_append,
+            jnp.minimum(eff.save_from, m.log_index + append_from_lane + 1),
+            eff.save_from,
+        )
+    )
+    last_idx_msg = m.log_index + m.n_ent
+    commit_to = jnp.minimum(
+        jnp.minimum(last_idx_msg, m.commit), s.last
+    )
+    s = mrep(s, accept, committed=jnp.maximum(s.committed, commit_to))
+    r_type = sel(h_rep & below_commit, MT.REPLICATE_RESP, r_type)
+    r_log_index = sel(h_rep & below_commit, s.committed, r_log_index)
+    r_type = sel(accept, MT.REPLICATE_RESP, r_type)
+    r_log_index = sel(accept, last_idx_msg, r_log_index)
+    rejected = h_rep & ~below_commit & (~prev_ok | over_cap)
+    r_type = sel(rejected, MT.REPLICATE_RESP, r_type)
+    r_reject = sel(rejected, True, r_reject)
+    r_log_index = sel(rejected, m.log_index, r_log_index)
+    r_hint = sel(rejected, s.last, r_hint)
+
+    # ---- Heartbeat (raft.go:1398 handleHeartbeatMessage) ----
+    h_hb = act & is_follower_like & (mtype == MT.HEARTBEAT)
+    s = mrep(s, h_hb, leader=m.from_, e_tick=0,
+             committed=jnp.maximum(s.committed, jnp.minimum(m.commit, s.last)))
+    r_type = sel(h_hb, MT.HEARTBEAT_RESP, r_type)
+    r_hint = sel(h_hb, m.hint, r_hint)
+    r_hint_high = sel(h_hb, m.hint_high, r_hint_high)
+
+    # ---- RequestVote (raft.go:1697 handleNodeRequestVote) ----
+    h_rv = act & (mtype == MT.REQUEST_VOTE)
+    can_grant = (s.vote == 0) | (s.vote == m.from_)
+    utd = up_to_date(kp, s, m.log_index, m.log_term)
+    grant = h_rv & can_grant & utd
+    s = mrep(s, grant, vote=m.from_, e_tick=0)
+    r_type = sel(h_rv, MT.REQUEST_VOTE_RESP, r_type)
+    r_reject = sel(h_rv & ~grant, True, r_reject)
+
+    # ---- RequestPreVote (raft.go:1670) ----
+    h_pv = act & (mtype == MT.REQUEST_PREVOTE)
+    pv_grant = h_pv & (m.term > s.term) & utd
+    r_type = sel(h_pv, MT.REQUEST_PREVOTE_RESP, r_type)
+    r_term = sel(pv_grant, m.term, r_term)
+    r_reject = sel(h_pv & ~pv_grant, True, r_reject)
+
+    # ---- RequestVoteResp (candidate; raft.go:2246) ----
+    h_vr = act & (s.role == P.CANDIDATE) & (mtype == MT.REQUEST_VOTE_RESP)
+    h_vr = h_vr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
+    not_seen = ~s.vresp[sender_slot]
+    s = s._replace(
+        vresp=s.vresp.at[sender_slot].set(sel(h_vr, True, s.vresp[sender_slot])),
+        vgrant=s.vgrant.at[sender_slot].set(
+            sel(h_vr & not_seen, ~m.reject, s.vgrant[sender_slot])
+        ),
+    )
+    votes_for = jnp.sum(s.vgrant.astype(I32))
+    votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
+    q = _quorum(s)
+    s, eff = _become_leader(kp, s, h_vr & (votes_for == q), eff)
+    s = _become_follower(s, h_vr & (votes_against == q), s.term, 0)
+
+    # ---- RequestPreVoteResp (raft.go:2267) ----
+    h_pvr = act & (s.role == P.PRE_VOTE_CANDIDATE) & (
+        mtype == MT.REQUEST_PREVOTE_RESP
+    )
+    h_pvr = h_pvr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
+    not_seen = ~s.vresp[sender_slot]
+    s = s._replace(
+        vresp=s.vresp.at[sender_slot].set(sel(h_pvr, True, s.vresp[sender_slot])),
+        vgrant=s.vgrant.at[sender_slot].set(
+            sel(h_pvr & not_seen, ~m.reject, s.vgrant[sender_slot])
+        ),
+    )
+    votes_for = jnp.sum(s.vgrant.astype(I32))
+    votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
+    s, eff = _campaign(kp, s, eff, h_pvr & (votes_for == q), allow_prevote=False)
+    s = _become_follower(s, h_pvr & (votes_against == q), s.term, 0)
+
+    # ---- ReplicateResp (leader; raft.go:1878) ----
+    h_rr = act & is_leader & (mtype == MT.REPLICATE_RESP) & sender_known
+    s = s._replace(active=s.active.at[sender_slot].set(
+        sel(h_rr, True, s.active[sender_slot])))
+    old_match = s.match[sender_slot]
+    old_next = s.next[sender_slot]
+    old_pstate = s.pstate[sender_slot]
+    paused = (old_pstate == P.R_WAIT) | (old_pstate == P.R_SNAPSHOT)
+    # non-reject: tryUpdate
+    ok_resp = h_rr & ~m.reject
+    updated = ok_resp & (old_match < m.log_index)
+    s = s._replace(
+        next=s.next.at[sender_slot].set(
+            sel(ok_resp, jnp.maximum(old_next, m.log_index + 1), old_next)
+        ),
+        match=s.match.at[sender_slot].set(
+            sel(updated, m.log_index, old_match)
+        ),
+    )
+    # wait_to_retry then respondedTo: retry→replicate; snapshot→retry if caught up
+    ps = s.pstate[sender_slot]
+    ps = sel(updated & (ps == P.R_WAIT), P.R_RETRY, ps)
+    ps = sel(updated & (ps == P.R_RETRY), P.R_REPLICATE, ps)
+    snap_caught = s.match[sender_slot] >= s.psnap[sender_slot]
+    ps = sel(updated & (ps == P.R_SNAPSHOT) & snap_caught, P.R_RETRY, ps)
+    s = s._replace(
+        pstate=s.pstate.at[sender_slot].set(sel(h_rr, ps, old_pstate)),
+        psnap=s.psnap.at[sender_slot].set(
+            sel(updated & (old_pstate == P.R_SNAPSHOT) & snap_caught,
+                0, s.psnap[sender_slot])
+        ),
+    )
+    committed_before = s.committed
+    s = jax.tree_util.tree_map(
+        lambda a, b: sel(updated, a, b), _try_commit(kp, s), s
+    )
+    commit_advanced = s.committed > committed_before
+    # broadcast on commit advance; else resend to the (formerly paused) peer
+    eff = eff._replace(
+        need_rep=sel(
+            updated & commit_advanced, jnp.ones_like(eff.need_rep),
+            eff.need_rep.at[sender_slot].set(
+                eff.need_rep[sender_slot] | (updated & ~commit_advanced & paused)
+            ),
+        )
+    )
+    # leadership transfer: target caught up → TimeoutNow (raft.go:1893)
+    tn = updated & (s.ltt == m.from_) & (s.match[sender_slot] == s.last)
+    eff = eff._replace(send_tn=eff.send_tn.at[sender_slot].set(
+        eff.send_tn[sender_slot] | tn))
+    # reject: decreaseTo (remote.go:decreaseTo) + resend
+    rej = h_rr & m.reject
+    in_replicate = old_pstate == P.R_REPLICATE
+    dec_ok_rep = rej & in_replicate & (m.log_index > old_match)
+    dec_ok_probe = rej & ~in_replicate & (old_next - 1 == m.log_index)
+    new_next = sel(
+        in_replicate, old_match + 1,
+        jnp.maximum(1, jnp.minimum(m.log_index, m.hint + 1)),
+    )
+    dec = dec_ok_rep | dec_ok_probe
+    s = s._replace(
+        next=s.next.at[sender_slot].set(sel(dec, new_next, s.next[sender_slot])),
+        pstate=s.pstate.at[sender_slot].set(
+            sel(dec_ok_rep, P.R_RETRY,
+                sel(dec_ok_probe & (s.pstate[sender_slot] == P.R_WAIT),
+                    P.R_RETRY, s.pstate[sender_slot]))
+        ),
+    )
+    eff = eff._replace(need_rep=eff.need_rep.at[sender_slot].set(
+        eff.need_rep[sender_slot] | dec))
+
+    # ---- HeartbeatResp (leader; raft.go:1912) ----
+    h_hr = act & is_leader & (mtype == MT.HEARTBEAT_RESP) & sender_known
+    s = s._replace(
+        active=s.active.at[sender_slot].set(sel(h_hr, True, s.active[sender_slot])),
+        pstate=s.pstate.at[sender_slot].set(
+            sel(h_hr & (s.pstate[sender_slot] == P.R_WAIT), P.R_RETRY,
+                s.pstate[sender_slot])
+        ),
+    )
+    lagging = s.match[sender_slot] < s.last
+    eff = eff._replace(need_rep=eff.need_rep.at[sender_slot].set(
+        eff.need_rep[sender_slot] | (h_hr & lagging)))
+    conf = h_hr & (m.hint != 0)
+    s_c, eff_c = _ri_confirm(kp, s, eff, conf, m.hint, m.hint_high, sender_slot)
+    s = jax.tree_util.tree_map(lambda a, b: sel(conf, a, b), s_c, s)
+    eff = jax.tree_util.tree_map(lambda a, b: sel(conf, a, b), eff_c, eff)
+
+    # ---- TimeoutNow (follower; raft.go:2188) ----
+    h_tn = act & (s.role == P.FOLLOWER) & (mtype == MT.TIMEOUT_NOW)
+    s = mrep(s, h_tn, is_ltt=True)
+    s, eff = _campaign(kp, s, eff, h_tn)
+    s = mrep(s, h_tn, is_ltt=False)
+
+    # ---- Unreachable (leader; raft.go:1997) ----
+    h_un = act & is_leader & (mtype == MT.UNREACHABLE) & sender_known
+    s = s._replace(pstate=s.pstate.at[sender_slot].set(
+        sel(h_un & (s.pstate[sender_slot] == P.R_REPLICATE), P.R_RETRY,
+            s.pstate[sender_slot])))
+
+    # ---- SnapshotStatus (leader, immediate variant; raft.go:1975) ----
+    h_ss = act & is_leader & (mtype == MT.SNAPSHOT_STATUS) & sender_known
+    in_snap = s.pstate[sender_slot] == P.R_SNAPSHOT
+    # becomeWait: next = max(match+1, psnap+1) on success; clear psnap on reject
+    nn = sel(
+        m.reject, s.match[sender_slot] + 1,
+        jnp.maximum(s.match[sender_slot] + 1, s.psnap[sender_slot] + 1),
+    )
+    s = s._replace(
+        next=s.next.at[sender_slot].set(
+            sel(h_ss & in_snap, nn, s.next[sender_slot])),
+        psnap=s.psnap.at[sender_slot].set(
+            sel(h_ss & in_snap, 0, s.psnap[sender_slot])),
+        pstate=s.pstate.at[sender_slot].set(
+            sel(h_ss & in_snap, P.R_WAIT, s.pstate[sender_slot])),
+    )
+
+    resp = (r_type, r_to, r_term, r_log_index, r_reject, r_hint, r_hint_high)
+    return s, eff, resp
+
+
+# ---------------------------------------------------------------------------
+# full per-shard step
+# ---------------------------------------------------------------------------
+
+
+def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
+    """Advance one shard one step (vmapped over [G])."""
+    E, K, B, RI, Pn = (
+        kp.msg_entries, kp.inbox_cap, kp.proposal_cap,
+        kp.readindex_cap, kp.num_peers,
+    )
+    eff = _empty_effects(kp)
+    save_base = s.stable  # entries above this are unsaved at step start
+
+    # 0. host-confirmed applied cursor
+    s = s._replace(applied=jnp.maximum(s.applied, inp.applied))
+
+    # 1. inbox scan — lax.scan so the (large) message processor compiles once
+    def _scan_msg(carry, m):
+        s_, eff_ = carry
+        s_, eff_, resp = _process_message(kp, s_, eff_, m)
+        return (s_, eff_), resp
+
+    (s, eff), r_stack = jax.lax.scan(_scan_msg, (s, eff), box)
+
+    # 2. batched ReadIndex request (node.go:1296 handleReadIndex batches all
+    #    queued reads under one ctx; host routes to the leader replica)
+    is_leader = s.role == P.LEADER
+    ri_req = inp.ri_valid & is_leader
+    lt_committed, comp_c, _ = log_term_at(kp, s, s.committed)
+    has_cur_term_commit = (sel(comp_c, 0, lt_committed) == s.term) & (s.term > 0)
+    single = _is_single_node(s)
+    # single-node fast path → ready immediately
+    fast = ri_req & single
+    lane = jnp.minimum(eff.rtr_n, RI - 1)
+    eff = eff._replace(
+        rtr_valid=eff.rtr_valid.at[lane].set(sel(fast, True, eff.rtr_valid[lane])),
+        rtr_index=eff.rtr_index.at[lane].set(sel(fast, s.committed, eff.rtr_index[lane])),
+        rtr_low=eff.rtr_low.at[lane].set(sel(fast, inp.ri_low, eff.rtr_low[lane])),
+        rtr_high=eff.rtr_high.at[lane].set(sel(fast, inp.ri_high, eff.rtr_high[lane])),
+        rtr_n=eff.rtr_n + sel(fast, 1, 0),
+    )
+    quorum_path = ri_req & ~single & has_cur_term_commit
+    s, dropped_full = _ri_push(kp, s, quorum_path, inp.ri_low, inp.ri_high,
+                               s.committed)
+    eff = eff._replace(
+        need_hb=eff.need_hb | (quorum_path & ~dropped_full),
+        hb_low=sel(quorum_path, inp.ri_low, eff.hb_low),
+        hb_high=sel(quorum_path, inp.ri_high, eff.hb_high),
+        ri_dropped=eff.ri_dropped
+        | (inp.ri_valid & (~is_leader | (ri_req & ~single & ~has_cur_term_commit)))
+        | dropped_full,
+    )
+
+    # 3. proposals (leader only, not while transferring; raft.go:1794)
+    can_prop = is_leader & (s.ltt == 0)
+
+    def _scan_prop(carry, pv):
+        s_, eff_, appended = carry
+        v_, is_cc_ = pv
+        # ring-capacity guard: refuse proposals that would overflow the term
+        # ring (host sees prop_accepted=False → system busy, mirroring the
+        # reference's in-mem log rate limiting; compaction frees space)
+        room = (s_.last + 1 - s_.snap_index) <= kp.log_cap
+        v_ = v_ & can_prop & room
+        # one-at-a-time config change: drop CC while one is pending
+        cc_ok = v_ & is_cc_ & ~s_.pending_cc
+        drop_cc = v_ & is_cc_ & s_.pending_cc
+        do = v_ & (~is_cc_ | cc_ok)
+        s_ = _append_one(kp, s_, do, s_.term, is_cc_ & cc_ok)
+        s_ = mrep(s_, cc_ok, pending_cc=True)
+        eff_ = eff_._replace(save_from=sel(
+            do, jnp.minimum(eff_.save_from, s_.last), eff_.save_from))
+        return (s_, eff_, appended | do), (
+            do & ~drop_cc, sel(do, s_.last, 0), sel(do, s_.term, 0))
+
+    (s, eff, appended_any), (prop_accepted, prop_index, prop_term) = jax.lax.scan(
+        _scan_prop, (s, eff, jnp.asarray(False)),
+        (inp.prop_valid, inp.prop_cc),
+    )
+    self_mask = _self_slot_mask(s)
+    s = s._replace(
+        match=sel(appended_any & self_mask, s.last, s.match),
+        next=sel(appended_any & self_mask, s.last + 1, s.next),
+    )
+    s = jax.tree_util.tree_map(
+        lambda a, b: sel(appended_any & single, a, b), _try_commit(kp, s), s
+    )
+    eff = eff._replace(need_rep=sel(appended_any, jnp.ones_like(eff.need_rep),
+                                    eff.need_rep))
+
+    # 4. leadership transfer request (raft.go:1925 handleLeaderTransfer)
+    tr = inp.transfer_to
+    tr_req = (tr != 0) & is_leader & (s.ltt == 0) & (tr != s.replica_id)
+    tr_hit = (s.pid == tr) & (s.kind == P.K_VOTER)
+    tr_known = jnp.any(tr_hit)
+    tr_slot = jnp.argmax(tr_hit)
+    do_tr = tr_req & tr_known
+    s = mrep(s, do_tr, ltt=tr, e_tick=0)
+    fast_tn = do_tr & (s.match[tr_slot] == s.last)
+    eff = eff._replace(send_tn=eff.send_tn.at[tr_slot].set(
+        eff.send_tn[tr_slot] | fast_tn))
+
+    # 5. tick (raft.go:571-655)
+    is_leader = s.role == P.LEADER  # refresh (campaigns can't happen above)
+    live_tick = inp.tick & ~inp.quiesced
+    # quiesced tick: just advance the election clock
+    s = mrep(s, inp.tick & inp.quiesced, e_tick=s.e_tick + 1)
+    # non-leader tick
+    nl = live_tick & ~is_leader
+    s = mrep(s, nl, e_tick=s.e_tick + 1)
+    can_campaign = (
+        (s.role == P.FOLLOWER) | (s.role == P.CANDIDATE)
+        | (s.role == P.PRE_VOTE_CANDIDATE)
+    )
+    elect = nl & can_campaign & (s.e_tick >= s.rand_timeout)
+    s = mrep(s, elect, e_tick=0)
+    s, eff = _campaign(kp, s, eff, elect)
+    # leader tick
+    lt_ = live_tick & is_leader
+    s = mrep(s, lt_, e_tick=s.e_tick + 1)
+    cq_time = lt_ & (s.e_tick >= s.e_timeout)
+    abort_tr = cq_time & (s.ltt != 0)
+    s = mrep(s, cq_time, e_tick=0)
+    # checkQuorum (raft.go:1785): count active voters (self counts), reset
+    do_cq = cq_time & s.check_quorum
+    active_v = jnp.sum(
+        (_voting_mask(s) & (s.active | _self_slot_mask(s))).astype(I32)
+    )
+    lost = do_cq & (active_v < _quorum(s))
+    s = s._replace(active=sel(do_cq, jnp.zeros_like(s.active), s.active))
+    s = _become_follower(s, lost, s.term, 0)
+    s = mrep(s, abort_tr & ~lost, ltt=0)
+    is_leader = s.role == P.LEADER
+    lt_ = lt_ & is_leader
+    s = mrep(s, lt_, h_tick=s.h_tick + 1)
+    hb_time = lt_ & (s.h_tick >= s.h_timeout)
+    s = mrep(s, hb_time, h_tick=0)
+    # heartbeat broadcast uses the newest pending RI ctx (raft.go:849)
+    RIm = kp.readindex_cap - 1
+    newest = (s.ri_head + s.ri_count - 1) & RIm
+    has_pending = s.ri_count > 0
+    eff = eff._replace(
+        need_hb=eff.need_hb | hb_time,
+        hb_low=sel(hb_time, sel(has_pending, s.ri_low[newest], 0), eff.hb_low),
+        hb_high=sel(hb_time, sel(has_pending, s.ri_high[newest], 0), eff.hb_high),
+    )
+
+    # 6. send phase ------------------------------------------------------
+    is_leader = s.role == P.LEADER
+    not_self = ~_self_slot_mask(s)
+    present = s.kind != P.K_ABSENT
+
+    # replicate lanes (sendReplicateMessage; raft.go:800)
+    want_rep = eff.need_rep & is_leader & present & not_self
+    pausedP = (s.pstate == P.R_WAIT) | (s.pstate == P.R_SNAPSHOT)
+    can_send = want_rep & ~pausedP
+    prev = s.next - 1
+    prev_term, prev_comp, _ = jax.vmap(lambda i: log_term_at(kp, s, i))(prev)
+    needs_snap = can_send & prev_comp  # log compacted under the peer
+    send_rep = can_send & ~prev_comp
+    n_avail = jnp.clip(s.last - prev, 0, E)
+    lane = jnp.arange(E, dtype=I32)
+    ent_idx = s.next[:, None] + lane[None, :]          # [P, E]
+    ent_live = lane[None, :] < n_avail[:, None]
+    eslot = _slot(kp, ent_idx)
+    ent_term = sel(ent_live, s.lt[eslot], 0)
+    ent_cc = sel(ent_live, s.lcc[eslot], False)
+    # optimistic pipelined advance (remote.go:progress)
+    adv = send_rep & (s.pstate == P.R_REPLICATE) & (n_avail > 0)
+    s = s._replace(
+        next=sel(adv, s.next + n_avail, s.next),
+        pstate=sel(send_rep & (s.pstate == P.R_RETRY), P.R_WAIT,
+                   sel(needs_snap, P.R_SNAPSHOT, s.pstate)),
+        psnap=sel(needs_snap, s.snap_index, s.psnap),
+    )
+    s = mrep(s, jnp.any(needs_snap), needs_host=True)
+
+    # heartbeat lanes (broadcastHeartbeatMessageWithHint; raft.go:859-871)
+    has_ctx = (eff.hb_low != 0) | (eff.hb_high != 0)
+    hb_target = present & not_self & (
+        _voting_mask(s) | (~has_ctx & (s.kind == P.K_NON_VOTING))
+    )
+    send_hb = eff.need_hb & is_leader & hb_target
+    hb_commit = jnp.minimum(s.match, s.committed)
+
+    # vote-request lanes — masked by END-OF-STEP role: a campaign started
+    # earlier in the step may have been cancelled by a later message (e.g.
+    # a higher-term Replicate folded us back to follower); only a live
+    # candidate may broadcast at its current term
+    role_ok = sel(eff.send_vote == 2, s.role == P.PRE_VOTE_CANDIDATE,
+                  s.role == P.CANDIDATE)
+    vr = (eff.send_vote > 0) & role_ok & _voting_mask(s) & not_self
+    vote_term = sel(eff.send_vote == 2, s.term + 1, s.term)
+    last_t, _, _ = log_term_at(kp, s, s.last)
+
+    # persistence: entries (save_first..save_last] inclusive-of-first form
+    save_first = sel(eff.save_from == INT_MAX, save_base + 1,
+                     jnp.minimum(eff.save_from, save_base + 1))
+    save_last = s.last
+    s = s._replace(stable=jnp.maximum(save_last, 0))
+
+    # apply release (pagination per logentry.go:268)
+    apply_first = s.processed + 1
+    apply_last = jnp.minimum(s.committed, s.processed + kp.apply_batch)
+    s = s._replace(processed=jnp.maximum(s.processed, apply_last))
+
+    out = StepOutput(
+        r_type=r_stack[0], r_to=r_stack[1], r_term=r_stack[2],
+        r_log_index=r_stack[3], r_reject=r_stack[4], r_hint=r_stack[5],
+        r_hint_high=r_stack[6],
+        s_rep=send_rep, s_prev_index=prev, s_prev_term=sel(prev_comp, 0, prev_term),
+        s_commit=jnp.broadcast_to(s.committed, (Pn,)),
+        s_n_ent=sel(send_rep, n_avail, 0),
+        s_ent_term=ent_term, s_ent_cc=ent_cc,
+        s_vote=sel(vr, eff.send_vote, 0),
+        s_vote_term=jnp.broadcast_to(vote_term, (Pn,)),
+        s_vote_lindex=jnp.broadcast_to(s.last, (Pn,)),
+        s_vote_lterm=jnp.broadcast_to(last_t, (Pn,)),
+        s_vote_hint=jnp.broadcast_to(eff.vote_hint, (Pn,)),
+        s_hb=send_hb, s_hb_commit=hb_commit,
+        s_hb_low=jnp.broadcast_to(eff.hb_low, (Pn,)),
+        s_hb_high=jnp.broadcast_to(eff.hb_high, (Pn,)),
+        s_timeout_now=eff.send_tn & is_leader,
+        s_need_snapshot=needs_snap,
+        save_first=save_first, save_last=save_last,
+        apply_first=apply_first, apply_last=apply_last,
+        term=s.term, vote=s.vote, commit=s.committed,
+        rtr_valid=eff.rtr_valid, rtr_index=eff.rtr_index,
+        rtr_low=eff.rtr_low, rtr_high=eff.rtr_high,
+        ri_dropped=eff.ri_dropped,
+        prop_accepted=prop_accepted, prop_index=prop_index, prop_term=prop_term,
+        leader=s.leader, leader_term=s.term,
+        needs_host=s.needs_host,
+    )
+    return s, out
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def step(kp: P.KernelParams, state: ShardState, inbox: Inbox,
+         inp: StepInput) -> tuple[ShardState, StepOutput]:
+    """vmap the per-shard step across the [G] axis and jit the result."""
+    return jax.vmap(functools.partial(_shard_step, kp))(state, inbox, inp)

@@ -1,0 +1,304 @@
+"""SoA device state for the batched Raft kernel.
+
+The reference keeps per-shard state in a ``raft`` struct of maps and slices
+(``internal/raft/raft.go:199-239``); here the same information is a
+structure-of-arrays pytree with a leading ``[G]`` shard axis so one vmapped
+step advances every shard in lockstep (BASELINE.json north star).  Peer books
+are fixed ``[G, P]`` lanes (the reference's ``remote`` is already fixed-width:
+remote.go:72), the entry log is a ``[G, CAP]`` term ring (payloads live
+host-side or in the device RSM's value lanes), and the ReadIndex book is a
+``[G, RI]`` circular queue.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dragonboat_tpu.core import params as P
+
+
+class ShardState(NamedTuple):
+    """Per-shard raft state; every field has a leading [G] axis (or [G, ...])."""
+
+    # identity / config
+    replica_id: jnp.ndarray     # [G] i32 — local replica id within the shard
+    seed: jnp.ndarray           # [G] i32 — PRNG stream id
+    e_timeout: jnp.ndarray      # [G] i32 — election timeout in ticks
+    h_timeout: jnp.ndarray      # [G] i32 — heartbeat timeout in ticks
+    check_quorum: jnp.ndarray   # [G] bool
+    pre_vote: jnp.ndarray       # [G] bool
+
+    # core protocol state
+    role: jnp.ndarray           # [G] i32 ∈ {FOLLOWER..WITNESS}
+    term: jnp.ndarray           # [G] i32
+    vote: jnp.ndarray           # [G] i32 (replica id, 0 = none)
+    leader: jnp.ndarray         # [G] i32 (0 = NoLeader)
+    applied: jnp.ndarray        # [G] i32 — RSM-confirmed applied index
+    e_tick: jnp.ndarray         # [G] i32
+    h_tick: jnp.ndarray         # [G] i32
+    rand_timeout: jnp.ndarray   # [G] i32
+    rand_counter: jnp.ndarray   # [G] i32 — bumps on each timeout reset
+    pending_cc: jnp.ndarray     # [G] bool
+    ltt: jnp.ndarray            # [G] i32 — leader-transfer target (0 none)
+    is_ltt: jnp.ndarray         # [G] bool — local node is transfer target
+
+    # peer books [G, P]
+    pid: jnp.ndarray            # peer replica ids (0 = empty slot)
+    kind: jnp.ndarray           # K_ABSENT/K_VOTER/K_NON_VOTING/K_WITNESS
+    match: jnp.ndarray          # i32
+    next: jnp.ndarray           # i32
+    pstate: jnp.ndarray         # R_RETRY/R_WAIT/R_REPLICATE/R_SNAPSHOT
+    active: jnp.ndarray         # bool — recent contact (checkQuorum)
+    psnap: jnp.ndarray          # i32 — pending install-snapshot index
+    vresp: jnp.ndarray          # bool — vote response received this election
+    vgrant: jnp.ndarray         # bool — vote granted
+
+    # log [G, CAP] ring + cursors
+    lt: jnp.ndarray             # [G, CAP] i32 — term of entry at index i (slot i & (CAP-1))
+    lcc: jnp.ndarray            # [G, CAP] bool — entry is a config change
+    snap_index: jnp.ndarray     # [G] i32 — last snapshot index (ring floor)
+    snap_term: jnp.ndarray      # [G] i32
+    last: jnp.ndarray           # [G] i32
+    committed: jnp.ndarray      # [G] i32
+    processed: jnp.ndarray      # [G] i32 — released to the apply pipeline
+    stable: jnp.ndarray         # [G] i32 — handed to the fsync pipeline
+
+    # ReadIndex circular book [G, RI] (+ acks [G, RI, P])
+    ri_low: jnp.ndarray
+    ri_high: jnp.ndarray
+    ri_index: jnp.ndarray
+    ri_acks: jnp.ndarray        # [G, RI, P] bool
+    ri_head: jnp.ndarray        # [G] i32
+    ri_count: jnp.ndarray       # [G] i32
+
+    # host-escalation flag: shard touched a path the kernel does not model
+    # (e.g. a peer needs an InstallSnapshot stream) — host must intervene
+    needs_host: jnp.ndarray     # [G] bool
+
+
+def init_state(
+    kp: P.KernelParams,
+    num_shards: int,
+    replica_id,
+    peer_ids,
+    peer_kinds=None,
+    election_timeout: int = 10,
+    heartbeat_timeout: int = 1,
+    check_quorum: bool = False,
+    pre_vote: bool = False,
+    seeds=None,
+) -> ShardState:
+    """Build a fresh [G] state.
+
+    ``replica_id``: scalar or [G] — the local replica id per shard.
+    ``peer_ids``: [P] or [G, P] replica ids (0 marks an empty slot).
+    ``peer_kinds``: same shape, defaults to K_VOTER for non-empty slots.
+    """
+    G, Pn, CAP, RI = num_shards, kp.num_peers, kp.log_cap, kp.readindex_cap
+    z = lambda *s: np.zeros((G, *s), np.int32)  # noqa: E731
+    zb = lambda *s: np.zeros((G, *s), bool)  # noqa: E731
+
+    rid = np.broadcast_to(np.asarray(replica_id, np.int32), (G,)).copy()
+    pids = np.asarray(peer_ids, np.int32)
+    if pids.ndim == 1:
+        pids = np.broadcast_to(pids, (G, Pn)).copy()
+    if peer_kinds is None:
+        kinds = np.where(pids != 0, P.K_VOTER, P.K_ABSENT).astype(np.int32)
+    else:
+        kinds = np.asarray(peer_kinds, np.int32)
+        if kinds.ndim == 1:
+            kinds = np.broadcast_to(kinds, (G, Pn)).copy()
+    if seeds is None:
+        seeds = (
+            np.arange(1, G + 1, dtype=np.int64) * 2654435761 % (1 << 31)
+            + rid.astype(np.int64) * 40503
+        ) % (1 << 31)
+        seeds = seeds.astype(np.int32)
+    et = np.full((G,), election_timeout, np.int32)
+    rand0 = np.asarray(
+        [
+            P.randomized_timeout(int(seeds[g]), 0, int(et[g]))
+            for g in range(G)
+        ],
+        np.int32,
+    )
+
+    is_nv = np.zeros((G,), bool)
+    is_wt = np.zeros((G,), bool)
+    for g in range(G):
+        slot = np.nonzero(pids[g] == rid[g])[0]
+        if slot.size:
+            is_nv[g] = kinds[g, slot[0]] == P.K_NON_VOTING
+            is_wt[g] = kinds[g, slot[0]] == P.K_WITNESS
+    role = np.where(is_wt, P.WITNESS, np.where(is_nv, P.NON_VOTING, P.FOLLOWER))
+
+    return ShardState(
+        replica_id=jnp.asarray(rid),
+        seed=jnp.asarray(seeds, jnp.int32),
+        e_timeout=jnp.asarray(et),
+        h_timeout=jnp.full((G,), heartbeat_timeout, jnp.int32),
+        check_quorum=jnp.full((G,), check_quorum, bool),
+        pre_vote=jnp.full((G,), pre_vote, bool),
+        role=jnp.asarray(role.astype(np.int32)),
+        term=jnp.asarray(z()),
+        vote=jnp.asarray(z()),
+        leader=jnp.asarray(z()),
+        applied=jnp.asarray(z()),
+        e_tick=jnp.asarray(z()),
+        h_tick=jnp.asarray(z()),
+        rand_timeout=jnp.asarray(rand0),
+        rand_counter=jnp.asarray(z()),
+        pending_cc=jnp.asarray(zb()),
+        ltt=jnp.asarray(z()),
+        is_ltt=jnp.asarray(zb()),
+        pid=jnp.asarray(pids),
+        kind=jnp.asarray(kinds),
+        match=jnp.asarray(z(Pn)),
+        next=jnp.asarray(z(Pn) + 1),
+        pstate=jnp.asarray(z(Pn)),
+        active=jnp.asarray(zb(Pn)),
+        psnap=jnp.asarray(z(Pn)),
+        vresp=jnp.asarray(zb(Pn)),
+        vgrant=jnp.asarray(zb(Pn)),
+        lt=jnp.asarray(z(CAP)),
+        lcc=jnp.asarray(zb(CAP)),
+        snap_index=jnp.asarray(z()),
+        snap_term=jnp.asarray(z()),
+        last=jnp.asarray(z()),
+        committed=jnp.asarray(z()),
+        processed=jnp.asarray(z()),
+        stable=jnp.asarray(z()),
+        ri_low=jnp.asarray(z(RI)),
+        ri_high=jnp.asarray(z(RI)),
+        ri_index=jnp.asarray(z(RI)),
+        ri_acks=jnp.asarray(zb(RI, Pn)),
+        ri_head=jnp.asarray(z()),
+        ri_count=jnp.asarray(z()),
+        needs_host=jnp.asarray(zb()),
+    )
+
+
+class Inbox(NamedTuple):
+    """Fixed-width inbound message block, [G, K] lanes (+ [G, K, E] entries).
+
+    Message fields mirror raftpb.Message (message.go:6-20) minus snapshots —
+    InstallSnapshot and ConfigChangeEvent are host-mediated and never enter
+    the kernel."""
+
+    mtype: jnp.ndarray      # i32 (NOOP = empty slot when from == 0)
+    from_: jnp.ndarray      # i32 replica id (0 = empty slot)
+    term: jnp.ndarray
+    log_term: jnp.ndarray
+    log_index: jnp.ndarray
+    commit: jnp.ndarray
+    reject: jnp.ndarray     # bool
+    hint: jnp.ndarray
+    hint_high: jnp.ndarray
+    n_ent: jnp.ndarray      # i32 — entries carried (replicate)
+    ent_term: jnp.ndarray   # [G, K, E] i32
+    ent_cc: jnp.ndarray     # [G, K, E] bool
+
+
+def empty_inbox(kp: P.KernelParams, num_shards: int) -> Inbox:
+    G, K, E = num_shards, kp.inbox_cap, kp.msg_entries
+    z = lambda *s: jnp.zeros((G, *s), jnp.int32)  # noqa: E731
+    return Inbox(
+        mtype=z(K), from_=z(K), term=z(K), log_term=z(K), log_index=z(K),
+        commit=z(K), reject=jnp.zeros((G, K), bool), hint=z(K), hint_high=z(K),
+        n_ent=z(K), ent_term=z(K, E), ent_cc=jnp.zeros((G, K, E), bool),
+    )
+
+
+class StepInput(NamedTuple):
+    """Everything a shard consumes in one step besides its inbox."""
+
+    # proposals [G, B]: valid + is-config-change marker; payloads stay host-side
+    prop_valid: jnp.ndarray     # [G, B] bool
+    prop_cc: jnp.ndarray        # [G, B] bool
+    # batched ReadIndex request (host batches all pending reads into one ctx
+    # per shard per step, mirroring node.handleReadIndex's batch ctx)
+    ri_valid: jnp.ndarray       # [G] bool
+    ri_low: jnp.ndarray         # [G] i32
+    ri_high: jnp.ndarray        # [G] i32
+    # leadership transfer request (0 = none)
+    transfer_to: jnp.ndarray    # [G] i32
+    # clock
+    tick: jnp.ndarray           # [G] bool — advance the logical clock
+    quiesced: jnp.ndarray       # [G] bool — tick in quiesced mode
+    # host acks: RSM applied cursor (monotonic)
+    applied: jnp.ndarray        # [G] i32
+
+
+def empty_input(kp: P.KernelParams, num_shards: int) -> StepInput:
+    G, B = num_shards, kp.proposal_cap
+    z = lambda *s: jnp.zeros((G, *s), jnp.int32)  # noqa: E731
+    zb = lambda *s: jnp.zeros((G, *s), bool)  # noqa: E731
+    return StepInput(
+        prop_valid=zb(B), prop_cc=zb(B),
+        ri_valid=zb(), ri_low=z(), ri_high=z(),
+        transfer_to=z(), tick=zb(), quiesced=zb(), applied=z(),
+    )
+
+
+class StepOutput(NamedTuple):
+    """Per-shard, per-step results (the device-side pb.Update contract —
+    update.go:74-112 re-expressed as fixed lanes)."""
+
+    # responses to inbox slots [G, K]
+    r_type: jnp.ndarray     # i32 (0 = none; NoOP uses its real enum value)
+    r_to: jnp.ndarray
+    r_term: jnp.ndarray
+    r_log_index: jnp.ndarray
+    r_reject: jnp.ndarray   # bool
+    r_hint: jnp.ndarray
+    r_hint_high: jnp.ndarray
+
+    # replicate/vote lanes per peer [G, P]
+    s_rep: jnp.ndarray      # bool — send a Replicate to this peer
+    s_prev_index: jnp.ndarray
+    s_prev_term: jnp.ndarray
+    s_commit: jnp.ndarray
+    s_n_ent: jnp.ndarray
+    s_ent_term: jnp.ndarray  # [G, P, E]
+    s_ent_cc: jnp.ndarray    # [G, P, E] bool
+    s_vote: jnp.ndarray      # i32: 0 none, 1 RequestVote, 2 RequestPreVote
+    s_vote_term: jnp.ndarray
+    s_vote_lindex: jnp.ndarray
+    s_vote_lterm: jnp.ndarray
+    s_vote_hint: jnp.ndarray
+    s_hb: jnp.ndarray        # bool — heartbeat to this peer
+    s_hb_commit: jnp.ndarray
+    s_hb_low: jnp.ndarray
+    s_hb_high: jnp.ndarray
+    s_timeout_now: jnp.ndarray  # bool
+    s_need_snapshot: jnp.ndarray  # bool — host must stream a snapshot
+
+    # persistence + apply pipeline [G]
+    save_first: jnp.ndarray
+    save_last: jnp.ndarray   # save (save_first..save_last]... inclusive range when >= first
+    apply_first: jnp.ndarray
+    apply_last: jnp.ndarray
+    term: jnp.ndarray        # pb.State triple for SaveRaftState
+    vote: jnp.ndarray
+    commit: jnp.ndarray
+
+    # ReadIndex results [G, RI]
+    rtr_valid: jnp.ndarray
+    rtr_index: jnp.ndarray
+    rtr_low: jnp.ndarray
+    rtr_high: jnp.ndarray
+    # dropped batched-read request (host re-queues / fails it)
+    ri_dropped: jnp.ndarray  # [G] bool
+
+    # proposal fates [G, B]
+    prop_accepted: jnp.ndarray  # bool
+    prop_index: jnp.ndarray     # assigned log index
+    prop_term: jnp.ndarray      # assigned term
+
+    # events [G]
+    leader: jnp.ndarray
+    leader_term: jnp.ndarray
+    needs_host: jnp.ndarray

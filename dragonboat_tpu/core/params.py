@@ -1,0 +1,86 @@
+"""Static kernel geometry + the shared counter-based PRNG.
+
+The batched kernel is compiled for a fixed geometry: G shards × P peer slots,
+a CAP-entry term ring, K inbox slots, B proposal slots and an RI-slot
+ReadIndex book per shard.  All lanes are int32: JAX's default integer width —
+terms/indexes are per-shard logical clocks that a shard would take years to
+overflow at raft rates, and the host records full-width u64 in raftpb.
+
+The randomized election timeout uses a splitmix32-style counter hash keyed by
+(shard seed, reset counter) so device and host cores draw identical values —
+this keeps the pycore differential oracle in exact lockstep
+(reference behavior: raft.go:658 setRandomizedElectionTimeout draws
+uniform [electionTimeout, 2*electionTimeout)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class KernelParams:
+    num_peers: int = 3          # P: peer slots per shard (max replicas)
+    log_cap: int = 1024         # CAP: term-ring capacity (power of two)
+    inbox_cap: int = 8          # K: inbound messages per shard per step
+    msg_entries: int = 8        # E: max entries carried per replicate message
+    proposal_cap: int = 8       # B: proposals per shard per step
+    readindex_cap: int = 8      # RI: pending ReadIndex contexts per shard
+    apply_batch: int = 64       # max committed entries released per step
+
+    def __post_init__(self) -> None:
+        assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"
+        assert self.readindex_cap & (self.readindex_cap - 1) == 0
+
+
+# role encoding — parity with pycore.RaftState / raft.go:63-71
+FOLLOWER = 0
+CANDIDATE = 1
+PRE_VOTE_CANDIDATE = 2
+LEADER = 3
+NON_VOTING = 4
+WITNESS = 5
+
+# peer-slot kinds
+K_ABSENT = 0
+K_VOTER = 1
+K_NON_VOTING = 2
+K_WITNESS = 3
+
+# remote flow-control states — parity remote.go:52-70
+R_RETRY = 0
+R_WAIT = 1
+R_REPLICATE = 2
+R_SNAPSHOT = 3
+
+NO_LEADER = 0
+
+
+import numpy as np
+
+_U = np.uint32
+
+
+def splitmix32(x):
+    """Deterministic 32-bit mixer usable from numpy scalars and jnp arrays.
+
+    Callers pass uint32-typed values; constants are np.uint32 so JAX's weak
+    typing doesn't reject them and numpy wraps mod 2^32."""
+    if isinstance(x, (int, np.integer)):
+        # host flavor: plain python ints, wrap mod 2^32
+        m = 0xFFFFFFFF
+        x = (int(x) + 0x9E3779B9) & m
+        z = ((x ^ (x >> 16)) * 0x85EBCA6B) & m
+        z = ((z ^ (z >> 13)) * 0xC2B2AE35) & m
+        return _U(z ^ (z >> 16))
+    x = x + _U(0x9E3779B9)
+    z = (x ^ (x >> _U(16))) * _U(0x85EBCA6B)
+    z = (z ^ (z >> _U(13))) * _U(0xC2B2AE35)
+    return z ^ (z >> _U(16))
+
+
+def randomized_timeout(seed: int, counter: int, election_timeout: int) -> int:
+    """election_timeout + uniform-ish [0, election_timeout) — host flavor,
+    bit-identical to the kernel's _next_rand_timeout draw."""
+    mixed = splitmix32((seed & 0xFFFFFFFF) ^ (((counter & 0xFFFFFFFF) * 0x632BE5AB) & 0xFFFFFFFF))
+    return election_timeout + int(mixed) % election_timeout

@@ -7,7 +7,9 @@ host-platform device mesh (the driver separately dry-runs multichip via
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the ambient environment may export JAX_PLATFORMS=axon (the real
+# TPU); unit tests always run on the virtual host-platform mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +19,7 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platform_name", "cpu")
+# persistent compile cache: the batched step kernel takes ~10-30s to compile;
+# cache it across pytest runs
+jax.config.update("jax_compilation_cache_dir", "/tmp/dragonboat_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
