@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Benchmark: sustained replicated writes/sec across raft groups on TPU.
+
+BASELINE config #2 shape: N groups × 3 replicas, 16B payloads, vmapped step
+loop with on-device message routing; every write is a full raft round
+(leader append → replicate → quorum ack → commit) with instant-apply RSM
+feedback and device-side log compaction.  Prints ONE JSON line.
+
+Baseline: the reference's 9M writes/s peak (3× 22-core Xeon servers,
+BASELINE.md) — vs_baseline is measured/9e6.
+
+Env knobs: BENCH_GROUPS (default 8192), BENCH_STEPS (default 200).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/dragonboat_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np  # noqa: E402
+
+from dragonboat_tpu.bench_loop import (  # noqa: E402
+    bench_params,
+    elect_all,
+    make_cluster,
+    run_steps,
+)
+from dragonboat_tpu.core import params as KP  # noqa: E402
+
+
+def main() -> None:
+    groups = int(os.environ.get("BENCH_GROUPS", "8192"))
+    steps = int(os.environ.get("BENCH_STEPS", "200"))
+    replicas = 3
+    kp = bench_params(replicas)
+
+    state = make_cluster(kp, groups, replicas)
+    state, box = elect_all(kp, replicas, state)
+    lead = np.asarray(state.role) == KP.LEADER
+    assert lead.reshape(-1, replicas).any(axis=1).all()
+
+    # warmup (compile the propose-loop variant)
+    state, box = run_steps(kp, replicas, 5, True, True, state, box)
+    state.term.block_until_ready()
+
+    c0 = np.asarray(state.committed)[lead].astype(np.int64).sum()
+    t0 = time.time()
+    state, box = run_steps(kp, replicas, steps, True, True, state, box)
+    state.committed.block_until_ready()
+    dt = time.time() - t0
+    c1 = np.asarray(state.committed)[lead].astype(np.int64).sum()
+
+    writes = int(c1 - c0)
+    wps = writes / dt
+    result = {
+        "metric": f"replicated writes/sec, {groups} groups x 3 replicas, 16B",
+        "value": round(wps),
+        "unit": "writes/s",
+        "vs_baseline": round(wps / 9e6, 4),
+        "detail": {
+            "groups": groups,
+            "steps": steps,
+            "wall_s": round(dt, 3),
+            "step_ms": round(dt / steps * 1e3, 3),
+            "writes": writes,
+            "writes_per_group_step": round(writes / steps / groups, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

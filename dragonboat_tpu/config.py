@@ -1,0 +1,124 @@
+"""Config / NodeHostConfig — parity with the reference's config package
+(``config/config.go:58-198`` per-shard Config, ``:300+`` NodeHostConfig,
+``Expert`` engine knobs ``:887-899``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class Config:
+    """Per-shard raft configuration (config/config.go:58-198)."""
+
+    replica_id: int = 0
+    shard_id: int = 0
+    check_quorum: bool = False
+    pre_vote: bool = False
+    election_rtt: int = 10
+    heartbeat_rtt: int = 1
+    snapshot_entries: int = 0        # 0 disables auto snapshots
+    compaction_overhead: int = 0
+    ordered_config_change: bool = False
+    max_in_mem_log_size: int = 0     # 0 = unlimited
+    is_non_voting: bool = False
+    is_witness: bool = False
+    quiesce: bool = False
+    wait_ready: bool = False
+    disable_auto_compaction: bool = False
+
+    def validate(self) -> None:
+        if self.replica_id == 0:
+            raise ConfigError("invalid ReplicaID")
+        if self.shard_id == 0:
+            raise ConfigError("invalid ShardID")
+        if self.heartbeat_rtt == 0:
+            raise ConfigError("HeartbeatRTT must be > 0")
+        if self.election_rtt == 0 or self.election_rtt <= 2 * self.heartbeat_rtt:
+            raise ConfigError(
+                "ElectionRTT must be > 2 * HeartbeatRTT"
+            )
+        if self.is_witness and self.snapshot_entries > 0:
+            raise ConfigError("witness can not take snapshots")
+        if self.is_witness and self.is_non_voting:
+            raise ConfigError("witness can not be a non-voting member")
+        if self.max_in_mem_log_size != 0 and self.max_in_mem_log_size < 256:
+            raise ConfigError("MaxInMemLogSize must be >= 256")
+
+
+@dataclass
+class EngineConfig:
+    """Expert engine geometry (config/config.go:887-899).  The TPU engine
+    maps ExecShards onto kernel batch slots rather than goroutine pools."""
+
+    exec_shards: int = 16
+    commit_shards: int = 16
+    apply_shards: int = 16
+    snapshot_shards: int = 48
+    close_shards: int = 32
+
+
+@dataclass
+class ExpertConfig:
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    # kernel geometry overrides (TPU-specific expert surface)
+    kernel_log_cap: int = 1024
+    kernel_inbox_cap: int = 8
+    kernel_msg_entries: int = 8
+    kernel_proposal_cap: int = 8
+
+
+@dataclass
+class GossipConfig:
+    bind_address: str = ""
+    advertise_address: str = ""
+    seed: list[str] = field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not (self.bind_address or self.advertise_address or self.seed)
+
+
+@dataclass
+class NodeHostConfig:
+    """Host-level configuration (config/config.go NodeHostConfig)."""
+
+    deployment_id: int = 0
+    wal_dir: str = ""
+    node_host_dir: str = ""
+    rtt_millisecond: int = 200
+    raft_address: str = ""
+    address_by_node_host_id: bool = False
+    listen_address: str = ""
+    mutual_tls: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    enable_metrics: bool = False
+    notify_commit: bool = False
+    max_send_queue_size: int = 0
+    max_receive_queue_size: int = 0
+    max_snapshot_send_bytes_per_second: int = 0
+    max_snapshot_recv_bytes_per_second: int = 0
+    gossip: GossipConfig = field(default_factory=GossipConfig)
+    expert: ExpertConfig = field(default_factory=ExpertConfig)
+    # pluggable factories (parity: config.LogDBFactory / TransportFactory)
+    logdb_factory: object | None = None
+    transport_factory: object | None = None
+    raft_event_listener: object | None = None
+    system_event_listener: object | None = None
+
+    def validate(self) -> None:
+        if self.rtt_millisecond == 0:
+            raise ConfigError("invalid RTTMillisecond")
+        if not self.raft_address:
+            raise ConfigError("RaftAddress not set")
+        if self.address_by_node_host_id and self.gossip.is_empty():
+            raise ConfigError("gossip must be configured for AddressByNodeHostID")
+
+    def prepare(self) -> None:
+        if not self.node_host_dir:
+            raise ConfigError("NodeHostDir not set")

@@ -883,6 +883,26 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     apply_last = jnp.minimum(s.committed, s.processed + kp.apply_batch)
     s = s._replace(processed=jnp.maximum(s.processed, apply_last))
 
+    # device-side log compaction — the ring analog of removeLog()
+    # (node.go:803): raise the snapshot floor over entries that are applied
+    # everywhere we care about, keeping compaction_overhead entries for
+    # laggards (config.CompactionOverhead). A leader also retains anything
+    # a present peer still needs (min match).
+    peer_floor = jnp.min(
+        sel(
+            (s.kind != P.K_ABSENT) & ~_self_slot_mask(s),
+            s.match, INT_MAX,
+        )
+    )
+    floor = jnp.minimum(s.applied, s.committed)
+    floor = sel(is_leader, jnp.minimum(floor, peer_floor), floor)
+    new_snap = jnp.maximum(
+        s.snap_index, floor - kp.compaction_overhead
+    )
+    new_snap_term, nsc, nsu = log_term_at(kp, s, new_snap)
+    can_compact = (new_snap > s.snap_index) & ~nsc & ~nsu
+    s = mrep(s, can_compact, snap_index=new_snap, snap_term=new_snap_term)
+
     out = StepOutput(
         r_type=r_stack[0], r_to=r_stack[1], r_term=r_stack[2],
         r_log_index=r_stack[3], r_reject=r_stack[4], r_hint=r_stack[5],

@@ -27,6 +27,7 @@ class KernelParams:
     proposal_cap: int = 8       # B: proposals per shard per step
     readindex_cap: int = 8      # RI: pending ReadIndex contexts per shard
     apply_batch: int = 64       # max committed entries released per step
+    compaction_overhead: int = 64  # retained entries below the compact floor
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

@@ -1,0 +1,16 @@
+"""Log storage engines behind raftio.ILogDB.
+
+- :class:`MemLogDB` — in-memory engine for tests and the loopback runtime
+  (the analog of the reference's pebble-on-MemFS configuration).
+- :mod:`.tan` — the file-backed engine modeled on the reference's tan
+  (per-shard append-only log files + in-memory index + manifest,
+  ``internal/tan/``), which is batch-append-shaped like the kernel's
+  SaveRaftState batches.
+- :class:`LogReader` — the raft core's cached read-side window over stable
+  storage (parity internal/logdb/logreader.go).
+"""
+
+from dragonboat_tpu.logdb.memdb import MemLogDB
+from dragonboat_tpu.logdb.logreader import LogReader
+
+__all__ = ["MemLogDB", "LogReader"]

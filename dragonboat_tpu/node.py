@@ -1,0 +1,411 @@
+"""Per-shard node: queues, pending books, Peer, RSM, snapshot glue.
+
+Parity with the reference's ``node.go``: the node owns the per-shard
+universe — ingress queues, pending-op books, the raft Peer, the managed
+state machine and the snapshotter — and exposes ``step()``, the engine's
+unit of work (stepNode/handleEvents → getUpdate → process, node.go:1139+).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.client import Session
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import CompactedError
+from dragonboat_tpu.core.peer import Peer
+from dragonboat_tpu.core.pycore import CoreConfig, Raft
+from dragonboat_tpu.logdb.logreader import LogReader
+from dragonboat_tpu.raftio import ILogDB
+from dragonboat_tpu.request import (
+    PendingProposal,
+    PendingReadIndex,
+    PendingSingleton,
+    RequestResultCode,
+    RequestState,
+)
+from dragonboat_tpu.rsm.statemachine import StateMachine
+from dragonboat_tpu.statemachine import Result
+
+
+@dataclass
+class _SnapshotRequest:
+    exported: bool = False
+    path: str = ""
+    override_compaction: bool = False
+    compaction_overhead: int = 0
+    key: int = 0
+
+
+class Node:
+    def __init__(
+        self,
+        cfg: Config,
+        logdb: ILogDB,
+        sm: StateMachine,
+        send_message,          # Callable[[pb.Message], None]
+        snapshot_dir: str,
+        rng=None,
+    ) -> None:
+        self.cfg = cfg
+        self.shard_id = cfg.shard_id
+        self.replica_id = cfg.replica_id
+        self.logdb = logdb
+        self.sm = sm
+        self.send_message = send_message
+        self.snapshot_dir = snapshot_dir
+        self.mu = threading.RLock()
+        self.log_reader = LogReader(cfg.shard_id, cfg.replica_id, logdb)
+
+        self.pending_proposals = PendingProposal()
+        self.pending_reads = PendingReadIndex()
+        self.pending_config_change = PendingSingleton()
+        self.pending_snapshot = PendingSingleton()
+        self.pending_transfer = PendingSingleton()
+
+        self.incoming_msgs: list[pb.Message] = []
+        self.incoming_proposals: list[pb.Entry] = []
+        self.transfer_target: int | None = None
+        self.config_change_entry: pb.Entry | None = None
+        self.snapshot_request: _SnapshotRequest | None = None
+
+        self.peer: Peer | None = None
+        self.stopped = False
+        self.applied_since_snapshot = 0
+        self.rng = rng
+        self.initial_applied = 0
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, initial_members: dict[int, str], initial: bool,
+              new_node: bool) -> None:
+        """startRaft (node.go:365): build the Peer from persisted state."""
+        ccfg = CoreConfig(
+            shard_id=self.shard_id,
+            replica_id=self.replica_id,
+            election_rtt=self.cfg.election_rtt,
+            heartbeat_rtt=self.cfg.heartbeat_rtt,
+            check_quorum=self.cfg.check_quorum,
+            pre_vote=self.cfg.pre_vote,
+            is_non_voting=self.cfg.is_non_voting,
+            is_witness=self.cfg.is_witness,
+        )
+        ss = self.logdb.get_snapshot(self.shard_id, self.replica_id)
+        if ss is not None:
+            self.log_reader.apply_snapshot(ss)
+        rs = self.logdb.read_raft_state(
+            self.shard_id, self.replica_id,
+            ss.index if ss is not None else 0,
+        )
+        have_state = rs is not None and (
+            not rs.state.is_empty() or rs.entry_count > 0 or ss is not None
+        )
+        if have_state:
+            assert rs is not None
+            if rs.entry_count > 0:
+                self.log_reader.set_range(rs.first_index, rs.entry_count)
+            p = Peer.launch(ccfg, self.log_reader, {}, False, False,
+                            rng=self.rng)
+            members = ss.membership if ss is not None else None
+            if members is None or not (
+                members.addresses or members.non_votings or members.witnesses
+            ):
+                members = pb.Membership(addresses=dict(initial_members))
+            p.raft.set_initial_members(
+                dict(members.addresses),
+                dict(members.non_votings),
+                dict(members.witnesses),
+            )
+            if not rs.state.is_empty():
+                p.raft.load_state(rs.state)
+            self.peer = p
+            # replay committed-but-unapplied entries through the RSM
+            if ss is not None:
+                self.sm.members.set(ss.membership)
+                self.sm.last_applied = max(self.sm.last_applied, ss.index)
+                self.sm.last_applied_term = ss.term
+        else:
+            self.peer = Peer.launch(
+                ccfg, self.log_reader, initial_members, initial, new_node,
+                rng=self.rng,
+            )
+            if initial and new_node:
+                self.sm.members.set(pb.Membership(
+                    config_change_id=0, addresses=dict(initial_members)))
+        applied = self.sm.get_last_applied()
+        self.initial_applied = applied
+        self.peer.notify_raft_last_applied(applied)
+        if applied > 0:
+            self.peer.raft.log.processed = max(
+                self.peer.raft.log.processed, applied)
+
+    def replay_committed(self) -> None:
+        """Replay committed entries above the RSM's applied index
+        (replayLog, node.go:666) — driven by the first engine steps."""
+        pass  # the normal step loop replays via entries_to_apply
+
+    def destroy(self) -> None:
+        self.stopped = True
+        for book in (self.pending_proposals, self.pending_reads,
+                     self.pending_config_change, self.pending_snapshot,
+                     self.pending_transfer):
+            book.terminate_all()
+        self.sm.close()
+
+    # -- client entry points (called from NodeHost) ------------------------
+
+    def propose(self, session: Session, cmd: bytes,
+                timeout_ticks: int) -> RequestState:
+        rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        with self.mu:
+            self.incoming_proposals.append(entry)
+        return rs
+
+    def propose_session_op(self, session: Session,
+                           timeout_ticks: int) -> RequestState:
+        rs, entry = self.pending_proposals.propose(session, b"", timeout_ticks)
+        with self.mu:
+            self.incoming_proposals.append(entry)
+        return rs
+
+    def read(self, timeout_ticks: int) -> RequestState:
+        return self.pending_reads.read(timeout_ticks)
+
+    def request_config_change(self, cc: pb.ConfigChange,
+                              timeout_ticks: int) -> RequestState:
+        rs, key = self.pending_config_change.request(timeout_ticks)
+        entry = pb.Entry(
+            type=pb.EntryType.CONFIG_CHANGE,
+            key=key,
+            cmd=pb.encode_config_change(cc),
+        )
+        with self.mu:
+            self.config_change_entry = entry
+        return rs
+
+    def request_leader_transfer(self, target: int,
+                                timeout_ticks: int) -> RequestState:
+        rs, _key = self.pending_transfer.request(timeout_ticks)
+        with self.mu:
+            self.transfer_target = target
+        return rs
+
+    def request_snapshot(self, req: _SnapshotRequest | None,
+                         timeout_ticks: int) -> RequestState:
+        rs, key = self.pending_snapshot.request(timeout_ticks)
+        r = req or _SnapshotRequest()
+        r.key = key
+        with self.mu:
+            self.snapshot_request = r
+        return rs
+
+    def handle_message(self, m: pb.Message) -> None:
+        with self.mu:
+            self.incoming_msgs.append(m)
+
+    def tick(self) -> None:
+        with self.mu:
+            self.incoming_msgs.append(
+                pb.Message(type=pb.MessageType.LOCAL_TICK))
+        for book in (self.pending_proposals, self.pending_reads,
+                     self.pending_config_change, self.pending_snapshot,
+                     self.pending_transfer):
+            book.advance()
+            book.gc()
+
+    # -- the step (engine unit of work; node.go:1139 stepNode) -------------
+
+    def step(self) -> bool:
+        if self.stopped or self.peer is None:
+            return False
+        peer = self.peer
+        with self.mu:
+            msgs, self.incoming_msgs = self.incoming_msgs, []
+            props, self.incoming_proposals = self.incoming_proposals, []
+            cc_entry, self.config_change_entry = self.config_change_entry, None
+            transfer, self.transfer_target = self.transfer_target, None
+            ss_req, self.snapshot_request = self.snapshot_request, None
+
+        # 1. read index batch (node.go:1296)
+        ctx = self.pending_reads.peep()
+        if ctx is not None:
+            peer.read_index(ctx)
+        # 2. received messages (incl. ticks)
+        for m in msgs:
+            if m.type == pb.MessageType.LOCAL_TICK:
+                if self.cfg.quiesce:
+                    peer.tick()  # quiesce manager integration later
+                else:
+                    peer.tick()
+            elif m.type == pb.MessageType.INSTALL_SNAPSHOT:
+                self._handle_install_snapshot(m)
+            else:
+                peer.handle(m)
+        # 3. config change (node.go:1310)
+        if cc_entry is not None:
+            peer.propose_entries([cc_entry])
+        # 4. proposals (node.go:1275)
+        if props:
+            peer.propose_entries(props)
+        # 5. leader transfer
+        if transfer is not None:
+            peer.request_leader_transfer(transfer)
+        # 6. snapshot request
+        if ss_req is not None:
+            self._take_snapshot(ss_req)
+
+        if not peer.has_update(True):
+            return False
+        ud = peer.get_update(True, self.sm.get_last_applied())
+        self._process_update(ud)
+        peer.commit(ud)
+        return True
+
+    # -- update processing (engine.go:1304 processSteps order) -------------
+
+    def _process_update(self, ud: pb.Update) -> None:
+        # send replicate messages BEFORE the fsync (thesis §10.2.1,
+        # engine.go:1332-1336)
+        for m in ud.messages:
+            if m.type == pb.MessageType.REPLICATE:
+                self._send(m)
+        # THE fsync
+        self.logdb.save_raft_state([ud], worker_id=0)
+        if ud.entries_to_save:
+            self.log_reader.append(ud.entries_to_save)
+        if not ud.snapshot.is_empty():
+            self._apply_snapshot(ud.snapshot)
+        # non-replicate messages after persistence
+        for m in ud.messages:
+            if m.type != pb.MessageType.REPLICATE:
+                self._send(m)
+        # dropped ops
+        for e in ud.dropped_entries:
+            self.pending_proposals.dropped(e.key)
+        for sc in ud.dropped_read_indexes:
+            self.pending_reads.dropped(sc)
+        # ready-to-read contexts
+        for rtr in ud.ready_to_reads:
+            self.pending_reads.add_ready(rtr.system_ctx, rtr.index)
+        # apply committed entries to the RSM
+        if ud.committed_entries:
+            self._apply_entries(ud.committed_entries)
+        # auto snapshot (node.go:694 saveSnapshotRequired)
+        if (self.cfg.snapshot_entries > 0
+                and self.applied_since_snapshot >= self.cfg.snapshot_entries):
+            self._take_snapshot(_SnapshotRequest())
+
+    def _send(self, m: pb.Message) -> None:
+        if m.to == self.replica_id:
+            self.handle_message(m)
+            return
+        self.send_message(m)
+
+    def _apply_entries(self, entries) -> None:
+        results = self.sm.handle(entries)
+        for r in results:
+            entry = next(e for e in entries if e.index == r.index)
+            if entry.is_config_change():
+                self._on_config_change_applied(entry, r)
+            elif r.key:
+                self.pending_proposals.applied(
+                    r.key, r.client_id, r.series_id, r.result, r.rejected
+                )
+        self.applied_since_snapshot += len(results)
+        applied = self.sm.get_last_applied()
+        if self.peer is not None:
+            self.peer.notify_raft_last_applied(applied)
+        self.pending_reads.applied(applied)
+
+    def _on_config_change_applied(self, entry: pb.Entry, r) -> None:
+        cc = pb.decode_config_change(entry.cmd)
+        assert self.peer is not None
+        if not r.rejected:
+            self.peer.apply_config_change(cc)
+            self.membership_changed_cb(cc)
+        else:
+            self.peer.reject_config_change()
+        code = (RequestResultCode.REJECTED if r.rejected
+                else RequestResultCode.COMPLETED)
+        self.pending_config_change.done(
+            entry.key, code, Result(value=entry.index))
+
+    def membership_changed_cb(self, cc: pb.ConfigChange) -> None:
+        """Overridden by NodeHost to update the registry."""
+
+    # -- snapshots -------------------------------------------------------
+
+    def _snapshot_path(self, index: int) -> str:
+        return os.path.join(
+            self.snapshot_dir,
+            f"snapshot-{self.shard_id:016X}-{self.replica_id:016X}-{index:016X}.gbsnap",
+        )
+
+    def _take_snapshot(self, req: _SnapshotRequest) -> None:
+        """save/doSave (node.go:739-801) executed inline (the reference
+        uses the snapshot worker pool; the loopback engine is synchronous)."""
+        assert self.peer is not None
+        index0 = self.sm.get_last_applied()
+        if index0 == 0:
+            if req.key:
+                self.pending_snapshot.done(req.key, RequestResultCode.REJECTED)
+            return
+        path = req.path if req.exported else self._snapshot_path(index0)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        index, term, membership = self.sm.save_snapshot(path)
+        ss = pb.Snapshot(
+            filepath=path,
+            file_size=os.path.getsize(path),
+            index=index,
+            term=term,
+            membership=membership,
+            shard_id=self.shard_id,
+            type=self.sm.sm_type,
+            on_disk_index=(index if self.sm.sm_type == pb.StateMachineType.ON_DISK
+                           else 0),
+        )
+        if not req.exported:
+            self.logdb.save_snapshots([pb.Update(
+                shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
+            )])
+            # compact the log, keeping compaction_overhead entries
+            overhead = (req.compaction_overhead if req.override_compaction
+                        else self.cfg.compaction_overhead)
+            compact_to = max(0, index - overhead)
+            if compact_to > 0 and not self.cfg.disable_auto_compaction:
+                try:
+                    self.log_reader.compact(compact_to)
+                    self.logdb.remove_entries_to(
+                        self.shard_id, self.replica_id, compact_to)
+                except Exception:
+                    pass
+        self.applied_since_snapshot = 0
+        if req.key:
+            self.pending_snapshot.done(
+                req.key, RequestResultCode.COMPLETED, snapshot_index=index)
+
+    def _handle_install_snapshot(self, m: pb.Message) -> None:
+        """Follower-side snapshot install: recover the RSM then feed the
+        raft core (host slow path; engine.go:1382 applySnapshotAndUpdate)."""
+        assert self.peer is not None
+        ss = m.snapshot
+        self.peer.raft.handle(m)  # raft-core restore (log + remotes)
+        if self.peer.raft.log.inmem.snapshot is not None:
+            # accepted: recover the user SM from the snapshot file
+            self.sm.recover_from_snapshot(ss.filepath, ss)
+
+    def _apply_snapshot(self, ss: pb.Snapshot) -> None:
+        self.logdb.save_snapshots([pb.Update(
+            shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss)])
+        self.log_reader.apply_snapshot(ss)
+
+    # -- info -----------------------------------------------------------
+
+    def leader_id(self) -> int:
+        return self.peer.raft.leader_id if self.peer else 0
+
+    def is_leader(self) -> bool:
+        return bool(self.peer and self.peer.raft.is_leader())

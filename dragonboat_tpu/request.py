@@ -1,0 +1,296 @@
+"""Request futures + pending-operation books.
+
+Parity with the reference's ``request.go``: every async op returns a
+RequestState whose completion fires when the op commits/applies
+(RequestState :294, pendingProposal :524, pendingReadIndex :535,
+pendingConfigChange :549, pendingSnapshot :557, pendingLeaderTransfer :564),
+with tick-driven timeout GC (logicalClock :236).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.statemachine import Result
+
+
+class RequestResultCode(IntEnum):
+    """Parity request.go:116 (RequestResult codes)."""
+
+    TIMEOUT = 0
+    COMPLETED = 1
+    TERMINATED = 2
+    REJECTED = 3
+    DROPPED = 4
+    ABORTED = 5
+    COMMITTED = 6
+
+
+class RequestError(Exception):
+    pass
+
+
+class RequestTimeoutError(RequestError):
+    pass
+
+
+class RequestRejectedError(RequestError):
+    pass
+
+
+class RequestDroppedError(RequestError):
+    """No leader / busy — retry later (ErrShardNotReady analog)."""
+
+
+class RequestTerminatedError(RequestError):
+    pass
+
+
+@dataclass
+class RequestResult:
+    code: RequestResultCode = RequestResultCode.TIMEOUT
+    result: Result = field(default_factory=Result)
+    snapshot_index: int = 0
+
+    def completed(self) -> bool:
+        return self.code == RequestResultCode.COMPLETED
+
+
+class RequestState:
+    """A completion future (request.go:294)."""
+
+    def __init__(self, key: int = 0, deadline_tick: int = 0) -> None:
+        self.key = key
+        self.deadline_tick = deadline_tick
+        self._event = threading.Event()
+        self.result = RequestResult()
+        self.committed_event = threading.Event()
+
+    def notify(self, result: RequestResult) -> None:
+        self.result = result
+        self._event.set()
+
+    def notify_committed(self) -> None:
+        self.committed_event.set()
+
+    def wait(self, timeout_s: float | None = None) -> RequestResult:
+        if not self._event.wait(timeout_s):
+            return RequestResult(code=RequestResultCode.TIMEOUT)
+        return self.result
+
+    def get(self, timeout_s: float | None = None) -> Result:
+        """Blocking result with error mapping (SyncPropose semantics)."""
+        r = self.wait(timeout_s)
+        if r.code == RequestResultCode.COMPLETED:
+            return r.result
+        if r.code == RequestResultCode.TIMEOUT:
+            raise RequestTimeoutError("request timed out")
+        if r.code == RequestResultCode.REJECTED:
+            raise RequestRejectedError("request rejected")
+        if r.code == RequestResultCode.DROPPED:
+            raise RequestDroppedError("request dropped, shard not ready")
+        if r.code == RequestResultCode.TERMINATED:
+            raise RequestTerminatedError("shard terminated")
+        raise RequestError(f"request failed: {r.code}")
+
+
+class _ClockedBook:
+    """Shared timeout machinery (logicalClock ticks)."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()
+        self.tick = 0
+
+    def advance(self) -> None:
+        self.tick += 1
+
+
+class PendingProposal(_ClockedBook):
+    """Proposal completion book keyed by entry Key (request.go:524/1016)."""
+
+    _seq = itertools.count(1)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.pending: dict[int, RequestState] = {}
+
+    def propose(self, session, cmd: bytes, timeout_ticks: int
+                ) -> tuple[RequestState, pb.Entry]:
+        key = next(self._seq)
+        entry = pb.Entry(
+            key=key,
+            client_id=session.client_id,
+            series_id=session.series_id,
+            responded_to=session.responded_to,
+            cmd=cmd,
+        )
+        rs = RequestState(key=key, deadline_tick=self.tick + timeout_ticks)
+        with self.mu:
+            self.pending[key] = rs
+        return rs, entry
+
+    def applied(self, key: int, client_id: int, series_id: int,
+                result: Result, rejected: bool) -> None:
+        with self.mu:
+            rs = self.pending.pop(key, None)
+        if rs is not None:
+            code = (RequestResultCode.REJECTED if rejected
+                    else RequestResultCode.COMPLETED)
+            rs.notify(RequestResult(code=code, result=result))
+
+    def committed(self, key: int) -> None:
+        with self.mu:
+            rs = self.pending.get(key)
+        if rs is not None:
+            rs.notify_committed()
+
+    def dropped(self, key: int) -> None:
+        with self.mu:
+            rs = self.pending.pop(key, None)
+        if rs is not None:
+            rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+
+    def gc(self) -> None:
+        with self.mu:
+            expired = [k for k, rs in self.pending.items()
+                       if rs.deadline_tick <= self.tick]
+            for k in expired:
+                self.pending.pop(k).notify(
+                    RequestResult(code=RequestResultCode.TIMEOUT))
+
+    def terminate_all(self) -> None:
+        with self.mu:
+            for rs in self.pending.values():
+                rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+            self.pending.clear()
+
+
+class PendingReadIndex(_ClockedBook):
+    """ReadIndex completion book (request.go:535): batches reads under a
+    SystemCtx, fires when appliedIndex passes the read index (:930)."""
+
+    _ctx = itertools.count(1)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.pending: dict[int, list[RequestState]] = {}   # ctx_low -> readers
+        self.batching: list[RequestState] = []
+        self.ready: dict[int, int] = {}                    # ctx_low -> index
+        self.waiting: list[tuple[int, RequestState]] = []  # (index, rs)
+
+    def read(self, timeout_ticks: int) -> RequestState:
+        rs = RequestState(deadline_tick=self.tick + timeout_ticks)
+        with self.mu:
+            self.batching.append(rs)
+        return rs
+
+    def peep(self) -> pb.SystemCtx | None:
+        """Take the current batch under a fresh ctx (nextCtx/peepNextCtx)."""
+        with self.mu:
+            if not self.batching:
+                return None
+            ctx = pb.SystemCtx(low=next(self._ctx), high=1)
+            self.pending[ctx.low] = self.batching
+            self.batching = []
+            return ctx
+
+    def add_ready(self, ctx: pb.SystemCtx, index: int) -> None:
+        with self.mu:
+            readers = self.pending.pop(ctx.low, None)
+            if readers is None:
+                return
+            self.waiting.extend((index, rs) for rs in readers)
+
+    def applied(self, applied_index: int) -> None:
+        """Fire every waiting read whose index has been applied."""
+        with self.mu:
+            still = []
+            fire = []
+            for index, rs in self.waiting:
+                if applied_index >= index:
+                    fire.append(rs)
+                else:
+                    still.append((index, rs))
+            self.waiting = still
+        for rs in fire:
+            rs.notify(RequestResult(code=RequestResultCode.COMPLETED))
+
+    def dropped(self, ctx: pb.SystemCtx) -> None:
+        with self.mu:
+            readers = self.pending.pop(ctx.low, None)
+        for rs in readers or ():
+            rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+
+    def gc(self) -> None:
+        with self.mu:
+            def expire(lst):
+                live, dead = [], []
+                for item in lst:
+                    rs = item[1] if isinstance(item, tuple) else item
+                    (dead if rs.deadline_tick <= self.tick else live).append(item)
+                return live, dead
+
+            self.batching, dead1 = expire(self.batching)
+            self.waiting, dead2 = expire(self.waiting)
+        for item in dead1 + dead2:
+            rs = item[1] if isinstance(item, tuple) else item
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+
+    def terminate_all(self) -> None:
+        with self.mu:
+            all_rs = list(self.batching)
+            all_rs += [rs for readers in self.pending.values() for rs in readers]
+            all_rs += [rs for _, rs in self.waiting]
+            self.batching, self.pending, self.waiting = [], {}, []
+        for rs in all_rs:
+            rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+
+
+class PendingSingleton(_ClockedBook):
+    """One-in-flight book for config change / snapshot / transfer
+    (request.go:549-570)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.key_seq = itertools.count(1)
+        self.outstanding: RequestState | None = None
+        self.key = 0
+
+    def request(self, timeout_ticks: int) -> tuple[RequestState, int]:
+        with self.mu:
+            if self.outstanding is not None:
+                raise RequestError("another request is already outstanding")
+            self.key = next(self.key_seq)
+            rs = RequestState(key=self.key,
+                              deadline_tick=self.tick + timeout_ticks)
+            self.outstanding = rs
+            return rs, self.key
+
+    def done(self, key: int, code: RequestResultCode,
+             result: Result = Result(), snapshot_index: int = 0) -> None:
+        with self.mu:
+            if self.outstanding is None or self.key != key:
+                return
+            rs, self.outstanding = self.outstanding, None
+        rs.notify(RequestResult(code=code, result=result,
+                                snapshot_index=snapshot_index))
+
+    def gc(self) -> None:
+        with self.mu:
+            rs = self.outstanding
+            if rs is not None and rs.deadline_tick <= self.tick:
+                self.outstanding = None
+            else:
+                rs = None
+        if rs is not None:
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+
+    def terminate_all(self) -> None:
+        with self.mu:
+            rs, self.outstanding = self.outstanding, None
+        if rs is not None:
+            rs.notify(RequestResult(code=RequestResultCode.TERMINATED))

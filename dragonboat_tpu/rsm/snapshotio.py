@@ -1,0 +1,131 @@
+"""Versioned snapshot file format with per-block CRCs.
+
+Parity with the reference's V2 snapshot format (``internal/rsm/snapshotio.go``
+header + ``rwv.go`` block writer/validator): a fixed header (version, sizes,
+checksum type, header CRC), a session payload, the user-SM payload written in
+CRC-framed blocks, and a footer with the payload checksum.  Corrupt blocks
+fail recovery instead of feeding bad state to the SM.
+
+Layout (little-endian):
+  magic "DBTPUSNP" | u32 version | u32 header_crc | u64 session_len
+  | session bytes | blocks: [u32 len | u32 crc | bytes]* | u32 0 terminator
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from typing import BinaryIO
+
+MAGIC = b"DBTPUSNP"
+V2 = 2
+BLOCK_SIZE = 256 * 1024
+
+
+class SnapshotFormatError(ValueError):
+    pass
+
+
+class BlockWriter:
+    """CRC-framed block writer (rwv.go IVWriter)."""
+
+    def __init__(self, f: BinaryIO, block_size: int = BLOCK_SIZE) -> None:
+        self.f = f
+        self.block_size = block_size
+        self.buf = bytearray()
+        self.payload_crc = 0
+
+    def write(self, data: bytes) -> int:
+        self.buf += data
+        while len(self.buf) >= self.block_size:
+            self._flush_block(self.buf[: self.block_size])
+            del self.buf[: self.block_size]
+        return len(data)
+
+    def _flush_block(self, block: bytes) -> None:
+        self.payload_crc = zlib.crc32(block, self.payload_crc)
+        self.f.write(struct.pack("<II", len(block), zlib.crc32(block)))
+        self.f.write(block)
+
+    def close(self) -> None:
+        if self.buf:
+            self._flush_block(bytes(self.buf))
+            self.buf.clear()
+        self.f.write(struct.pack("<I", 0))  # terminator
+        self.f.write(struct.pack("<I", self.payload_crc))
+
+
+class BlockReader:
+    """Validating reader over CRC-framed blocks (rwv.go IVReader)."""
+
+    def __init__(self, f: BinaryIO) -> None:
+        self.f = f
+        self.payload_crc = 0
+        self.buf = bytearray()
+        self.eof = False
+
+    def _fill(self) -> None:
+        if self.eof:
+            return
+        hdr = self.f.read(4)
+        (ln,) = struct.unpack("<I", hdr)
+        if ln == 0:
+            (expect,) = struct.unpack("<I", self.f.read(4))
+            if expect != self.payload_crc:
+                raise SnapshotFormatError("payload checksum mismatch")
+            self.eof = True
+            return
+        (crc,) = struct.unpack("<I", self.f.read(4))
+        block = self.f.read(ln)
+        if len(block) != ln or zlib.crc32(block) != crc:
+            raise SnapshotFormatError("block checksum mismatch")
+        self.payload_crc = zlib.crc32(block, self.payload_crc)
+        self.buf += block
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            while not self.eof:
+                self._fill()
+            out = bytes(self.buf)
+            self.buf.clear()
+            return out
+        while len(self.buf) < n and not self.eof:
+            self._fill()
+        out = bytes(self.buf[:n])
+        del self.buf[:n]
+        return out
+
+
+def write_snapshot(f: BinaryIO, session_data: bytes,
+                   write_payload) -> None:
+    """write_payload(w) receives a BlockWriter for the SM payload."""
+    header = struct.pack("<Q", len(session_data))
+    f.write(MAGIC)
+    f.write(struct.pack("<I", V2))
+    f.write(struct.pack("<I", zlib.crc32(header)))
+    f.write(header)
+    f.write(struct.pack("<I", zlib.crc32(session_data)))
+    f.write(session_data)
+    w = BlockWriter(f)
+    write_payload(w)
+    w.close()
+
+
+def read_snapshot(f: BinaryIO):
+    """Returns (session_bytes, BlockReader for the payload)."""
+    if f.read(8) != MAGIC:
+        raise SnapshotFormatError("bad magic")
+    (version,) = struct.unpack("<I", f.read(4))
+    if version != V2:
+        raise SnapshotFormatError(f"unsupported version {version}")
+    (hcrc,) = struct.unpack("<I", f.read(4))
+    header = f.read(8)
+    if zlib.crc32(header) != hcrc:
+        raise SnapshotFormatError("header checksum mismatch")
+    (slen,) = struct.unpack("<Q", header)
+    (scrc,) = struct.unpack("<I", f.read(4))
+    session = f.read(slen)
+    if zlib.crc32(session) != scrc:
+        raise SnapshotFormatError("session checksum mismatch")
+    return session, BlockReader(f)

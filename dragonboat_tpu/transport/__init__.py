@@ -1,0 +1,7 @@
+"""Transport layer: message batches + snapshot streaming behind
+raftio.ITransport (SURVEY §2.7)."""
+
+from dragonboat_tpu.transport.chan import ChanTransport, ChanTransportFactory
+from dragonboat_tpu.transport.hub import TransportHub
+
+__all__ = ["ChanTransport", "ChanTransportFactory", "TransportHub"]

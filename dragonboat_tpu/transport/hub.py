@@ -1,0 +1,143 @@
+"""TransportHub: send queues, batching, circuit breakers over an ITransport.
+
+Parity with ``internal/transport/transport.go:173`` (Transport): per-target
+send queues drained into MessageBatch frames, a circuit breaker per address
+(:176-177, :293), failure → unreachable callbacks funneled back to raft as
+Unreachable messages, and snapshot chunk dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.raftio import INodeRegistry, ITransport
+
+SEND_QUEUE_LEN = 1024 * 2
+BREAKER_RESET_SECONDS = 1.0
+
+
+class CircuitBreaker:
+    """Minimal failure breaker (transport.go GetCircuitBreaker)."""
+
+    def __init__(self, reset_after: float = BREAKER_RESET_SECONDS) -> None:
+        self.reset_after = reset_after
+        self.tripped_at = 0.0
+        self.mu = threading.Lock()
+
+    def ready(self) -> bool:
+        with self.mu:
+            return (time.monotonic() - self.tripped_at) >= self.reset_after
+
+    def fail(self) -> None:
+        with self.mu:
+            self.tripped_at = time.monotonic()
+
+    def succeed(self) -> None:
+        with self.mu:
+            self.tripped_at = 0.0
+
+
+class TransportHub:
+    def __init__(
+        self,
+        source_address: str,
+        deployment_id: int,
+        transport: ITransport,
+        resolver: INodeRegistry,
+        unreachable_cb: Callable[[pb.Message], None],
+        sync: bool = True,
+    ) -> None:
+        self.source_address = source_address
+        self.deployment_id = deployment_id
+        self.transport = transport
+        self.resolver = resolver
+        self.unreachable_cb = unreachable_cb
+        self.sync = sync
+        self.mu = threading.Lock()
+        self.queues: dict[str, deque[pb.Message]] = {}
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self.metrics = {"sent": 0, "send_failed": 0, "dropped": 0}
+
+    def breaker(self, addr: str) -> CircuitBreaker:
+        with self.mu:
+            b = self.breakers.get(addr)
+            if b is None:
+                b = self.breakers[addr] = CircuitBreaker()
+            return b
+
+    def send(self, m: pb.Message) -> bool:
+        """Enqueue and (synchronously, in the loopback runtime) flush one
+        message — Send (transport.go:115-136)."""
+        if m.is_local():
+            raise AssertionError("local message sent to transport")
+        try:
+            addr, _key = self.resolver.resolve(m.shard_id, m.to)
+        except KeyError:
+            self.metrics["dropped"] += 1
+            return False
+        b = self.breaker(addr)
+        if not b.ready():
+            self.metrics["dropped"] += 1
+            self._notify_unreachable(m)
+            return False
+        with self.mu:
+            q = self.queues.setdefault(addr, deque(maxlen=SEND_QUEUE_LEN))
+            q.append(m)
+        if self.sync:
+            self.flush(addr)
+        return True
+
+    def flush(self, addr: str | None = None) -> None:
+        addrs = [addr] if addr else list(self.queues)
+        for a in addrs:
+            with self.mu:
+                q = self.queues.get(a)
+                if not q:
+                    continue
+                msgs = tuple(q)
+                q.clear()
+            batch = pb.MessageBatch(
+                requests=msgs,
+                deployment_id=self.deployment_id,
+                source_address=self.source_address,
+            )
+            b = self.breaker(a)
+            try:
+                conn = self.transport.get_connection(a)
+                conn.send_message_batch(batch)
+                b.succeed()
+                self.metrics["sent"] += len(msgs)
+            except Exception:
+                b.fail()
+                self.metrics["send_failed"] += len(msgs)
+                for m in msgs:
+                    self._notify_unreachable(m)
+
+    def send_snapshot_chunks(self, m: pb.Message, chunks) -> bool:
+        """Send an InstallSnapshot as a chunk stream (snapshot.go:211)."""
+        try:
+            addr, _ = self.resolver.resolve(m.shard_id, m.to)
+        except KeyError:
+            return False
+        try:
+            conn = self.transport.get_snapshot_connection(addr)
+            for c in chunks:
+                conn.send_chunk(c)
+            return True
+        except Exception:
+            self._notify_unreachable(m)
+            return False
+
+    def _notify_unreachable(self, m: pb.Message) -> None:
+        self.unreachable_cb(
+            pb.Message(
+                type=pb.MessageType.UNREACHABLE,
+                from_=m.to,
+                to=m.from_,
+                shard_id=m.shard_id,
+            )
+        )

@@ -1,0 +1,84 @@
+"""Device-router tests: full raft clusters with zero host routing."""
+
+import numpy as np
+
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.kstate import empty_input, empty_inbox, init_state
+from dragonboat_tpu.core.router import cluster_step
+
+
+def make(n_groups, replicas=3, **kw):
+    kp = KP.KernelParams(
+        num_peers=replicas, log_cap=256, inbox_cap=5 * (replicas - 1),
+        msg_entries=4, proposal_cap=4, readindex_cap=4,
+    )
+    G = n_groups * replicas
+    rids = np.tile(np.arange(1, replicas + 1, dtype=np.int32), n_groups)
+    pids = np.arange(1, replicas + 1, dtype=np.int32)
+    st = init_state(kp, G, rids, pids, **kw)
+    return kp, st
+
+
+def test_device_routed_election_and_commit():
+    kp, st = make(4)
+    box = empty_inbox(kp, st.term.shape[0])
+    inp_t = empty_input(kp, st.term.shape[0])._replace(
+        tick=np.ones(st.term.shape[0], bool))
+    out = None
+    for i in range(60):
+        st, box, out = cluster_step(kp, 3, st, box, inp_t)
+        role = np.asarray(st.role).reshape(4, 3)
+        if (role == KP.LEADER).any(axis=1).all():
+            break
+    role = np.asarray(st.role).reshape(4, 3)
+    assert (role == KP.LEADER).any(axis=1).all(), "not all groups elected"
+    # settle: let noops commit
+    inp0 = empty_input(kp, st.term.shape[0])
+    for _ in range(6):
+        st, box, out = cluster_step(kp, 3, st, box, inp0)
+    committed = np.asarray(st.committed)
+    assert (committed == 1).all()
+
+    # propose on every leader row via input lanes
+    lead_rows = np.flatnonzero(np.asarray(st.role) == KP.LEADER)
+    pv = np.zeros((st.term.shape[0], kp.proposal_cap), bool)
+    pv[lead_rows, :2] = True
+    inp_p = inp0._replace(prop_valid=pv)
+    st, box, out = cluster_step(kp, 3, st, box, inp_p)
+    assert np.asarray(out.prop_accepted)[lead_rows][:, :2].all()
+    for _ in range(6):
+        st, box, out = cluster_step(kp, 3, st, box, inp0)
+    assert (np.asarray(st.committed) == 3).all()
+    # identical term rings within groups
+    lt = np.asarray(st.lt).reshape(4, 3, -1)
+    assert (lt == lt[:, :1]).all()
+
+
+def test_device_routed_steady_state_throughput_commits():
+    """Pipeline proposals every step; commits must advance steadily."""
+    kp, st = make(2)
+    G = st.term.shape[0]
+    box = empty_inbox(kp, G)
+    tick = empty_input(kp, G)._replace(tick=np.ones(G, bool))
+    idle = empty_input(kp, G)
+    for _ in range(40):
+        st, box, _ = cluster_step(kp, 3, st, box, tick)
+        if (np.asarray(st.role).reshape(2, 3) == KP.LEADER).any(axis=1).all():
+            break
+    for _ in range(6):
+        st, box, _ = cluster_step(kp, 3, st, box, idle)
+    lead = np.flatnonzero(np.asarray(st.role) == KP.LEADER)
+    c0 = int(np.asarray(st.committed)[lead].sum())
+    steps = 30
+    for i in range(steps):
+        pv = np.zeros((G, kp.proposal_cap), bool)
+        pv[lead, :] = True  # 4 proposals per leader per step
+        st, box, _ = cluster_step(kp, 3, st, box, idle._replace(prop_valid=pv))
+    # drain
+    for _ in range(6):
+        st, box, _ = cluster_step(kp, 3, st, box, idle)
+    c1 = int(np.asarray(st.committed)[lead].sum())
+    total = c1 - c0
+    assert total == 2 * steps * kp.proposal_cap, (
+        f"committed {total}, want {2 * steps * kp.proposal_cap}"
+    )

@@ -1,0 +1,123 @@
+"""Kernel chaos tests: safety invariants under drops/dups/partitions.
+
+The model is the reference's monkey-test strategy (docs/test.md, monkey.go):
+random message loss, duplication and partitions while proposing, then assert
+the raft safety properties: at most one leader per term, identical committed
+prefixes across replicas, commit monotonicity.  Determinism of the kernel
+(same seeds → same run) is asserted too — bitwise reproducibility is a core
+TPU-design requirement (SURVEY §7 'Determinism').
+"""
+
+import random
+
+import numpy as np
+
+from dragonboat_tpu.core import params as KP
+from kernel_harness import KernelCluster
+
+
+def run_chaos(seed: int, steps: int = 400, groups: int = 4):
+    rng = random.Random(seed)
+    c = KernelCluster(groups, 3)
+    leaders_by_term: dict[tuple[int, int], int] = {}  # (group, term) -> leader rid
+    proposed = 0
+    commit_watermark = np.zeros(c.G, np.int64)
+
+    for step_i in range(steps):
+        # random chaos: drop pairs, toggle isolation
+        c.dropped_pairs = set()
+        for g in range(c.G):
+            for h in range(c.G):
+                if g != h and rng.random() < 0.08:
+                    c.dropped_pairs.add((g, h))
+        if rng.random() < 0.02:
+            c.isolated = {rng.randrange(c.G)}
+        elif rng.random() < 0.05:
+            c.isolated = set()
+        # random duplication: re-enqueue a pending message
+        for g in range(c.G):
+            if c.pending[g] and rng.random() < 0.1:
+                c.pending[g].append(rng.choice(c.pending[g]))
+
+        proposals = {}
+        for grp in range(groups):
+            lrow = c.leader_row(grp)
+            if lrow is not None and rng.random() < 0.5:
+                proposals[lrow] = rng.randrange(1, 3)
+                proposed += 1
+        c.step(tick=True, proposals=proposals)
+
+        # safety: at most one leader per (group, term)
+        role = c.field("role")
+        term = c.field("term")
+        for grp in range(groups):
+            for r in range(grp * 3, grp * 3 + 3):
+                if role[r] == KP.LEADER:
+                    key = (grp, int(term[r]))
+                    rid = r % 3 + 1
+                    if key in leaders_by_term:
+                        assert leaders_by_term[key] == rid, (
+                            f"TWO LEADERS in group {grp} term {term[r]}"
+                        )
+                    leaders_by_term[key] = rid
+        # safety: commit never regresses
+        committed = c.field("committed").astype(np.int64)
+        assert (committed >= commit_watermark).all(), "commit regressed"
+        commit_watermark = np.maximum(commit_watermark, committed)
+
+    # heal and drain
+    c.isolated = set()
+    c.dropped_pairs = set()
+    for _ in range(60):
+        c.step(tick=True)
+    return c, proposed
+
+
+def check_log_matching(c: KernelCluster, groups: int):
+    lt = c.field("lt")
+    committed = c.field("committed")
+    last = c.field("last")
+    cap = c.kp.log_cap
+    for grp in range(groups):
+        rows = [grp * 3 + i for i in range(3)]
+        cmin = int(min(committed[r] for r in rows))
+        # committed prefix must be identical across replicas
+        for i in range(1, cmin + 1):
+            slot = i & (cap - 1)
+            terms = {int(lt[r][slot]) for r in rows if last[r] >= i}
+            assert len(terms) == 1, (
+                f"log divergence group {grp} index {i}: {terms}"
+            )
+
+
+def test_kernel_chaos_safety():
+    c, proposed = run_chaos(seed=12345)
+    check_log_matching(c, 4)
+    # liveness after heal: every group has a leader and converged commits
+    committed = c.field("committed")
+    for grp in range(4):
+        assert c.leader_row(grp) is not None
+        rows = [grp * 3 + i for i in range(3)]
+        assert len({int(committed[r]) for r in rows}) == 1, "commit not converged"
+    assert proposed > 20
+
+
+def test_kernel_chaos_second_seed():
+    c, _ = run_chaos(seed=999, steps=300)
+    check_log_matching(c, 4)
+
+
+def test_kernel_determinism():
+    """Same seeds → bitwise-identical state evolution (no hidden entropy)."""
+    def run(n):
+        c = KernelCluster(2, 3)
+        for i in range(40):
+            props = {0: 1} if i % 5 == 0 else None
+            c.step(tick=True, proposals=props)
+        return c
+
+    a, b = run(0), run(1)
+    for f in ("term", "role", "vote", "leader", "committed", "last", "lt",
+              "match", "next", "e_tick", "rand_timeout"):
+        fa, fb = np.asarray(getattr(a.state, f)), np.asarray(getattr(b.state, f))
+        assert (fa == fb).all(), f"nondeterminism in field {f}"

@@ -1,0 +1,246 @@
+"""End-to-end NodeHost tests: multi-NodeHost clusters in one process over
+the chan transport (the reference's nodehost_test.go strategy on MemFS +
+plugin/chan — SURVEY §4.3)."""
+
+import struct
+import time
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.request import RequestTimeoutError
+
+
+class KVStateMachine(IStateMachine):
+    """cmd = "key=value"; lookup = key; snapshot = whole dict."""
+
+    def __init__(self, shard_id, replica_id):
+        self.kv = {}
+        self.update_count = 0
+
+    def update(self, entry):
+        self.update_count += 1
+        k, v = entry.cmd.decode().split("=", 1)
+        self.kv[k] = v
+        return Result(value=len(self.kv))
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = "\n".join(f"{k}={v}" for k, v in sorted(self.kv.items()))
+        w.write(struct.pack("<I", len(data)))
+        w.write(data.encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        (n,) = struct.unpack("<I", r.read(4))
+        data = r.read(n).decode()
+        self.kv = dict(line.split("=", 1) for line in data.split("\n") if line)
+
+
+ADDRS = {1: "nh-1", 2: "nh-2", 3: "nh-3"}
+
+
+def make_cluster(shard_id=1, n=3, snapshot_entries=0, rtt_ms=5,
+                 addr_prefix="nh"):
+    addrs = {i: f"{addr_prefix}-{i}" for i in range(1, n + 1)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=rtt_ms,
+                                     node_host_dir="/tmp/x"))
+        cfg = Config(shard_id=shard_id, replica_id=rid, election_rtt=10,
+                     heartbeat_rtt=1, snapshot_entries=snapshot_entries,
+                     compaction_overhead=5)
+        nh.start_replica(addrs, False, KVStateMachine, cfg)
+        hosts[rid] = nh
+    return hosts, addrs
+
+
+def wait_leader(hosts, shard_id=1, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in hosts.values():
+            lid, ok = nh.get_leader_id(shard_id)
+            if ok:
+                return lid
+        time.sleep(0.02)
+    raise AssertionError("no leader elected")
+
+
+@pytest.fixture
+def cluster():
+    hosts, addrs = make_cluster(addr_prefix=f"nhA{time.monotonic_ns()}")
+    yield hosts
+    for nh in hosts.values():
+        nh.close()
+
+
+def test_sync_propose_and_read(cluster):
+    hosts = cluster
+    lid = wait_leader(hosts)
+    nh = hosts[lid]
+    s = nh.get_noop_session(1)
+    r = nh.sync_propose(s, b"alpha=1")
+    assert r.value == 1
+    nh.sync_propose(s, b"beta=2")
+    assert nh.sync_read(1, "alpha") == "1"
+    assert nh.sync_read(1, "beta") == "2"
+    # replicas converge; stale read from a follower
+    frid = next(r for r in hosts if r != lid)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if hosts[frid].stale_read(1, "beta") == "2":
+            break
+        time.sleep(0.02)
+    assert hosts[frid].stale_read(1, "beta") == "2"
+
+
+def test_propose_via_follower_host(cluster):
+    """The reference forwards proposals from follower to leader through the
+    raft core; host routing makes any NodeHost a valid entry point."""
+    hosts = cluster
+    lid = wait_leader(hosts)
+    frid = next(r for r in hosts if r != lid)
+    nh = hosts[frid]
+    s = nh.get_noop_session(1)
+    r = nh.sync_propose(s, b"k=via-follower")
+    assert r.value >= 1
+    assert nh.sync_read(1, "k") == "via-follower"
+
+
+def test_client_session_exactly_once(cluster):
+    hosts = cluster
+    lid = wait_leader(hosts)
+    nh = hosts[lid]
+    s = nh.sync_get_session(1)
+    r1 = nh.sync_propose(s, b"x=1")
+    # replay the same series id (simulating a client retry after timeout):
+    s.series_id -= 1
+    r2 = nh.sync_propose(s, b"x=SHOULD-NOT-APPLY")
+    # dedup: the second proposal returns the cached result, not a new apply
+    assert r2.value == r1.value
+    assert nh.sync_read(1, "x") == "1"
+    # update count proves single application
+    leader_sm = nh._node(1).sm.sm
+    assert leader_sm.kv["x"] == "1"
+    nh.sync_close_session(s)
+
+
+def test_membership_add_and_remove(cluster):
+    hosts = cluster
+    lid = wait_leader(hosts)
+    nh = hosts[lid]
+    m = nh.sync_get_shard_membership(1)
+    assert sorted(m.addresses) == [1, 2, 3]
+    # add a 4th replica
+    addr4 = list(cluster.values())[0].config.raft_address.rsplit("-", 1)[0] + "-4"
+    nh.sync_request_add_replica(1, 4, addr4, m.config_change_id)
+    nh4 = NodeHost(NodeHostConfig(raft_address=addr4, rtt_millisecond=5,
+                                  node_host_dir="/tmp/x"))
+    try:
+        cfg = Config(shard_id=1, replica_id=4, election_rtt=10, heartbeat_rtt=1)
+        nh4.start_replica({}, True, KVStateMachine, cfg)
+        s = nh.get_noop_session(1)
+        nh.sync_propose(s, b"after=join")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if nh4.stale_read(1, "after") == "join":
+                break
+            time.sleep(0.02)
+        assert nh4.stale_read(1, "after") == "join"
+        m = nh.sync_get_shard_membership(1)
+        assert sorted(m.addresses) == [1, 2, 3, 4]
+        # remove it again
+        nh.sync_request_delete_replica(1, 4, m.config_change_id)
+        m = nh.sync_get_shard_membership(1)
+        assert sorted(m.addresses) == [1, 2, 3]
+        assert 4 in m.removed
+    finally:
+        nh4.close()
+
+
+def test_leader_transfer(cluster):
+    hosts = cluster
+    lid = wait_leader(hosts)
+    target = next(r for r in hosts if r != lid)
+    hosts[lid].request_leader_transfer(1, target)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        nlid, ok = hosts[target].get_leader_id(1)
+        if ok and nlid == target:
+            break
+        time.sleep(0.02)
+    assert hosts[target].get_leader_id(1)[0] == target
+
+
+def test_snapshot_and_restart():
+    prefix = f"nhS{time.monotonic_ns()}"
+    hosts, addrs = make_cluster(addr_prefix=prefix)
+    try:
+        lid = wait_leader(hosts)
+        nh = hosts[lid]
+        s = nh.get_noop_session(1)
+        for i in range(20):
+            nh.sync_propose(s, f"k{i}={i}".encode())
+        idx = nh.sync_request_snapshot(1)
+        assert idx >= 20
+        # restart one follower from its logdb (simulating process restart)
+        frid = next(r for r in hosts if r != lid)
+        old = hosts[frid]
+        logdb = old.logdb
+        old.close()
+        nh2 = NodeHost(NodeHostConfig(raft_address=addrs[frid],
+                                      rtt_millisecond=5, node_host_dir="/tmp/x"),
+                       logdb=logdb)
+        hosts[frid] = nh2
+        cfg = Config(shard_id=1, replica_id=frid, election_rtt=10,
+                     heartbeat_rtt=1)
+        nh2.start_replica(addrs, False, KVStateMachine, cfg)
+        nh.sync_propose(s, b"post=restart")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if nh2.stale_read(1, "post") == "restart":
+                break
+            time.sleep(0.02)
+        assert nh2.stale_read(1, "post") == "restart"
+        assert nh2.stale_read(1, "k5") == "5"
+    finally:
+        for nh_ in hosts.values():
+            nh_.close()
+
+
+def test_partitioned_host_times_out():
+    prefix = f"nhP{time.monotonic_ns()}"
+    hosts, _ = make_cluster(addr_prefix=prefix)
+    try:
+        lid = wait_leader(hosts)
+        nh = hosts[lid]
+        # partition the leader's transport (monkey hook)
+        for h in hosts.values():
+            h.transport.partitioned = h is nh
+        s = nh.get_noop_session(1)
+        with pytest.raises(Exception):
+            nh.sync_propose(s, b"lost=1", timeout_s=0.4)
+        # heal; the cluster recovers (possibly with a new leader)
+        for h in hosts.values():
+            h.transport.partitioned = False
+        lid2 = wait_leader(hosts)
+        s2 = hosts[lid2].get_noop_session(1)
+        hosts[lid2].sync_propose(s2, b"healed=1")
+        assert hosts[lid2].sync_read(1, "healed") == "1"
+    finally:
+        for nh_ in hosts.values():
+            nh_.close()
+
+
+def test_node_host_info(cluster):
+    hosts = cluster
+    lid = wait_leader(hosts)
+    info = hosts[lid].get_node_host_info()
+    assert len(info.shard_info_list) == 1
+    si = info.shard_info_list[0]
+    assert si.shard_id == 1 and si.is_leader
+    assert sorted(si.membership.addresses) == [1, 2, 3]
