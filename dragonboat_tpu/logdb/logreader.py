@@ -23,8 +23,10 @@ class LogReader:
         self.logdb = logdb
         self._mu = threading.RLock()
         self._snapshot = pb.Snapshot()
-        self._marker = 1      # index of the first available entry
-        self._length = 1      # marker-1 acts as a virtual entry (its term is known)
+        # parity logreader.go:74-80 (NewLogReader): markerIndex=0, length=1,
+        # so first_index()==1 and a fresh node accepts the bootstrap entry 1
+        self._marker = 0      # marker acts as a virtual entry (its term is known)
+        self._length = 1
         self._marker_term = 0
 
     # -- ILogDBReader ----------------------------------------------------

@@ -242,6 +242,10 @@ class Node:
                     peer.tick()
             elif m.type == pb.MessageType.INSTALL_SNAPSHOT:
                 self._handle_install_snapshot(m)
+            elif m.is_local():
+                # locally-generated signals (Unreachable, SnapshotStatus, …)
+                # bypass the external-message gate (node.go:1347-1400)
+                peer.raft.handle(m)
             else:
                 peer.handle(m)
         # 3. config change (node.go:1310)
@@ -287,9 +291,12 @@ class Node:
             self.pending_proposals.dropped(e.key)
         for sc in ud.dropped_read_indexes:
             self.pending_reads.dropped(sc)
-        # ready-to-read contexts
+        # ready-to-read contexts; fire immediately when the applied index
+        # already covers the read index (request.go:930 applied())
         for rtr in ud.ready_to_reads:
             self.pending_reads.add_ready(rtr.system_ctx, rtr.index)
+        if ud.ready_to_reads:
+            self.pending_reads.applied(self.sm.get_last_applied())
         # apply committed entries to the RSM
         if ud.committed_entries:
             self._apply_entries(ud.committed_entries)

@@ -210,6 +210,14 @@ class NodeHost:
     def _handle_message_batch(self, batch: pb.MessageBatch) -> None:
         """Inbound dispatch (messageHandler.HandleMessageBatch,
         nodehost.go:2072)."""
+        if batch.deployment_id != self.config.deployment_id:
+            return  # transport.go:306-311 deployment-id gate
+        # learn the sender's address so responses resolve even before any
+        # membership entry applies locally (transport.go:317-324)
+        if batch.source_address:
+            for m in batch.requests:
+                if m.from_ != 0:
+                    self.registry.add(m.shard_id, m.from_, batch.source_address)
         for m in batch.requests:
             with self.mu:
                 node = self.nodes.get(m.shard_id)
@@ -222,7 +230,11 @@ class NodeHost:
         whole-snapshot message in the loopback runtime."""
         m = chunk.get("message")
         if m is not None:
-            self._handle_message_batch(pb.MessageBatch(requests=(m,)))
+            self._handle_message_batch(pb.MessageBatch(
+                requests=(m,),
+                deployment_id=self.config.deployment_id,
+                source_address=chunk.get("source_address", ""),
+            ))
         return True
 
     def _on_unreachable(self, m: pb.Message) -> None:

@@ -1,0 +1,13 @@
+"""Multi-chip execution: sharded cluster steps over a jax.sharding.Mesh.
+
+The reference scales by spreading replicas of each raft group over NodeHosts
+connected by TCP (``internal/transport/transport.go:86-101``); the TPU-native
+equivalent co-schedules the whole cluster as one SPMD program and exchanges
+message blocks over ICI collectives (SURVEY §7.8).
+"""
+
+from dragonboat_tpu.parallel.ici import (  # noqa: F401
+    IciCluster,
+    make_ici_cluster,
+    ici_cluster_step,
+)

@@ -1,0 +1,199 @@
+"""ICI transport: cross-chip replica groups via shard_map + collectives.
+
+The reference's replicas talk over a framed TCP transport
+(``internal/transport/tcp.go:64-394``); when every replica of a group is a
+row of the same SPMD program, that transport seam collapses into an
+``all_gather`` of the step's fixed-width out-lanes over the mesh's replica
+axis — the message blocks ride ICI, and the per-address circuit breakers /
+send queues disappear because delivery is the collective itself.
+
+Layout
+------
+Mesh ``('g', 'r')``: axis ``r`` has one device per replica slot (R total);
+axis ``g`` block-parallelizes disjoint group sets (no communication).  The
+global state has leading dim ``G = g_size * R * n_local`` laid out
+block-major: row ``((ig * R) + ir) * n_local + n`` is replica ``ir+1`` of
+group ``ig * n_local + n``, so a flat ``P(('g', 'r'))`` sharding gives
+device ``(ig, ir)`` the ``n_local`` rows of its replica slot.
+
+Each step: local batched raft step → ``all_gather`` out-lanes over ``'r'``
+→ rebuild the grouped ``[n_local * R]`` view → reuse the single-device
+router → keep the rows addressed to my replica slot.  Correctness therefore
+reduces to the router's (tests/test_device_router.py); these collectives
+only change *where* the lanes live.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.kernel import step
+from dragonboat_tpu.core.kstate import (
+    Inbox,
+    ShardState,
+    StepInput,
+    StepOutput,
+    empty_inbox,
+    init_state,
+)
+from dragonboat_tpu.core.router import route
+
+
+@dataclass(frozen=True)
+class IciCluster:
+    """Static geometry of a mesh-sharded cluster."""
+
+    kp: KP.KernelParams
+    mesh: Mesh
+    replicas: int        # R — size of mesh axis 'r'
+    n_local: int         # groups per device
+    num_groups: int      # total groups = g_size * n_local
+
+    @property
+    def g_size(self) -> int:
+        return self.mesh.shape["g"]
+
+    @property
+    def total_rows(self) -> int:
+        return self.g_size * self.replicas * self.n_local
+
+    def sharding(self, extra_dims: int = 0) -> NamedSharding:
+        return NamedSharding(self.mesh, PS(("g", "r"), *([None] * extra_dims)))
+
+    def shard(self, tree):
+        """Place a [G]-leading pytree onto the mesh."""
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self.sharding(x.ndim - 1)), tree
+        )
+
+
+def make_ici_cluster(
+    kp: KP.KernelParams,
+    mesh: Mesh,
+    num_groups: int,
+    election: int = 10,
+) -> tuple[IciCluster, ShardState, Inbox]:
+    """Build a cluster whose replica axis spans mesh axis 'r'.
+
+    ``num_groups`` must divide evenly over mesh axis 'g'."""
+    R = mesh.shape["r"]
+    g_size = mesh.shape["g"]
+    assert num_groups % g_size == 0, "num_groups must divide mesh axis g"
+    n_local = num_groups // g_size
+    cluster = IciCluster(kp=kp, mesh=mesh, replicas=R, n_local=n_local,
+                         num_groups=num_groups)
+
+    # block-major replica-id layout (see module docstring)
+    rids = np.empty((cluster.total_rows,), np.int32)
+    for ig in range(g_size):
+        for ir in range(R):
+            lo = (ig * R + ir) * n_local
+            rids[lo:lo + n_local] = ir + 1
+    pids = np.arange(1, R + 1, dtype=np.int32)
+    state = init_state(kp, cluster.total_rows, rids, pids,
+                       election_timeout=election)
+    box = empty_inbox(kp, cluster.total_rows)
+    return cluster, cluster.shard(state), cluster.shard(box)
+
+
+def _ici_body(kp: KP.KernelParams, replicas: int,
+              state: ShardState, box: Inbox, inp: StepInput):
+    """shard_map body: local [n_local] step + collective message exchange."""
+    R = replicas
+    state, out = step(kp, state, box, inp)
+
+    # exchange out-lanes across the replica axis: [n_local,...] -> [R, n_local,...]
+    gathered = jax.tree.map(
+        lambda x: jax.lax.all_gather(x, "r", axis=0), out
+    )
+
+    n_local = state.term.shape[0]
+
+    def to_grouped(x):  # [R, n_local, ...] -> [n_local * R, ...] group-major
+        x = jnp.swapaxes(x, 0, 1)
+        return x.reshape((n_local * R,) + x.shape[2:])
+
+    out_full = StepOutput(*[to_grouped(f) for f in gathered])
+    box_full = route(kp, R, out_full)          # [n_local * R, ...] grouped
+    t = jax.lax.axis_index("r")
+
+    def mine(x):  # keep rows addressed to my replica slot
+        g = x.reshape((n_local, R) + x.shape[1:])
+        return jax.lax.dynamic_index_in_dim(g, t, axis=1, keepdims=False)
+
+    box = jax.tree.map(mine, box_full)
+    return state, box, out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _jit_ici_step(kp, cluster: IciCluster, state, box, inp):
+    body = jax.shard_map(
+        functools.partial(_ici_body, kp, cluster.replicas),
+        mesh=cluster.mesh,
+        in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
+        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
+        check_vma=False,
+    )
+    return body(state, box, inp)
+
+
+def ici_cluster_step(cluster: IciCluster, state: ShardState, box: Inbox,
+                     inp: StepInput):
+    """One cluster step with cross-chip message routing.
+
+    Equivalent of router.cluster_step for mesh-resident replicas; the
+    transport seam (raftio.ITransport) is the all_gather inside."""
+    return _jit_ici_step(cluster.kp, cluster, state, box, inp)
+
+
+def self_driving_input(kp: KP.KernelParams, state: ShardState,
+                       tick: bool = True, propose: bool = True) -> StepInput:
+    """bench_loop.full_step's feedback shape for sharded state: proposals on
+    leaders, instant-apply RSM cursor, logical clock ticking."""
+    G, B = state.term.shape[0], kp.proposal_cap
+    is_leader = state.role == KP.LEADER
+    pv = jnp.broadcast_to(is_leader[:, None], (G, B)) & jnp.asarray(propose)
+    z = lambda: jnp.zeros((G,), jnp.int32)  # noqa: E731
+    return StepInput(
+        prop_valid=pv,
+        prop_cc=jnp.zeros((G, B), bool),
+        ri_valid=jnp.zeros((G,), bool),
+        ri_low=z(),
+        ri_high=z(),
+        transfer_to=z(),
+        tick=jnp.broadcast_to(jnp.asarray(tick, bool), (G,)),
+        quiesced=jnp.zeros((G,), bool),
+        applied=state.processed,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def ici_run_steps(kp, cluster: IciCluster, iters: int, propose: bool,
+                  state, box):
+    """iters self-driving sharded steps under one jit (bench inner loop)."""
+    body_fn = functools.partial(_ici_body, kp, cluster.replicas)
+
+    def one(st, bx):
+        inp = self_driving_input(kp, st, tick=True, propose=propose)
+        st, bx, _ = body_fn(st, bx, inp)
+        return st, bx
+
+    def sharded(st, bx):
+        return jax.lax.fori_loop(
+            0, iters, lambda _, c: one(*c), (st, bx)
+        )
+
+    return jax.shard_map(
+        sharded,
+        mesh=cluster.mesh,
+        in_specs=(PS(("g", "r")), PS(("g", "r"))),
+        out_specs=(PS(("g", "r")), PS(("g", "r"))),
+        check_vma=False,
+    )(state, box)

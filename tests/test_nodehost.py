@@ -60,11 +60,17 @@ def make_cluster(shard_id=1, n=3, snapshot_entries=0, rtt_ms=5,
 
 
 def wait_leader(hosts, shard_id=1, timeout=10.0):
+    """Wait until a majority of hosts agree on one leader (avoids returning a
+    stale leader right after a partition heals)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
+        votes = {}
         for nh in hosts.values():
             lid, ok = nh.get_leader_id(shard_id)
             if ok:
+                votes[lid] = votes.get(lid, 0) + 1
+        for lid, n in votes.items():
+            if n > len(hosts) // 2 and lid in hosts:
                 return lid
         time.sleep(0.02)
     raise AssertionError("no leader elected")
