@@ -106,6 +106,15 @@ class LogReader:
             self._marker_term = ss.term
             self._length = 1
 
+    def create_snapshot(self, ss: pb.Snapshot) -> None:
+        """Record a locally-taken snapshot without resetting the window
+        (logreader.go CreateSnapshot) — it becomes the payload of
+        InstallSnapshot messages to lagging peers."""
+        with self._mu:
+            if ss.index < self._snapshot.index:
+                return
+            self._snapshot = ss
+
     def set_state(self, st: pb.State) -> None:
         pass  # state is persisted by the engine; nothing cached here
 

@@ -68,16 +68,17 @@ class MemLogDB(ILogDB):
                     st.state = ud.state
                 if not ud.snapshot.is_empty():
                     st.snapshot = ud.snapshot
-                for e in ud.entries_to_save:
-                    st.entries[e.index] = e
-                    st.max_index = max(st.max_index, e.index)
                 if ud.entries_to_save:
-                    # truncate any stale suffix above the new tail (conflict
-                    # overwrite semantics)
-                    tail = ud.entries_to_save[-1].index
+                    # conflict overwrite: a batch starting at `first`
+                    # invalidates every previously-stored entry at or above
+                    # it, regardless of term (the reference overwrites by
+                    # index unconditionally on the save path)
+                    first = ud.entries_to_save[0].index
                     for i in list(st.entries):
-                        if i > tail and st.entries[i].term < ud.entries_to_save[-1].term:
+                        if i >= first:
                             del st.entries[i]
+                    for e in ud.entries_to_save:
+                        st.entries[e.index] = e
                     st.max_index = max(st.entries) if st.entries else 0
 
     def iterate_entries(self, shard_id, replica_id, low, high, max_size):

@@ -378,6 +378,9 @@ class Node:
             self.logdb.save_snapshots([pb.Update(
                 shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
             )])
+            # make the snapshot visible to makeInstallSnapshotMessage
+            # (snapshotter.Commit → logReader.CreateSnapshot)
+            self.log_reader.create_snapshot(ss)
             # compact the log, keeping compaction_overhead entries
             overhead = (req.compaction_overhead if req.override_compaction
                         else self.cfg.compaction_overhead)

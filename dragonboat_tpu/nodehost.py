@@ -35,6 +35,7 @@ from dragonboat_tpu.request import (
 from dragonboat_tpu.rsm.statemachine import StateMachine
 from dragonboat_tpu.statemachine import Result
 from dragonboat_tpu.transport.chan import ChanTransportFactory
+from dragonboat_tpu.transport.chunks import ChunkSink
 from dragonboat_tpu.transport.hub import TransportHub
 
 DEFAULT_TIMEOUT_S = 5.0
@@ -75,9 +76,14 @@ class NodeHost:
         self.registry = Registry()
         self.mu = threading.RLock()
         self.nodes: dict[int, Node] = {}
+        self.chunk_sink = ChunkSink(
+            snapshot_dir=f"/tmp/dragonboat_tpu/{self.id}/incoming",
+            deployment_id=nhconfig.deployment_id,
+            deliver=self._on_snapshot_reassembled,
+        )
         factory = nhconfig.transport_factory or ChanTransportFactory()
         self.transport = factory.create(
-            nhconfig, self._handle_message_batch, self._handle_chunk)
+            nhconfig, self._handle_message_batch, self.chunk_sink.add)
         self.transport.start()
         self.hub = TransportHub(
             source_address=nhconfig.raft_address,
@@ -173,6 +179,7 @@ class NodeHost:
                     nodes = list(self.nodes.values())
                 for n in nodes:
                     n.tick()
+                self.chunk_sink.tick()
             self.run_once()
 
     def run_once(self) -> int:
@@ -225,17 +232,15 @@ class NodeHost:
                 node.handle_message(m)
         self._work.set()
 
-    def _handle_chunk(self, chunk: dict) -> bool:
-        """Snapshot chunk intake: reassembled by the chan transport into a
-        whole-snapshot message in the loopback runtime."""
-        m = chunk.get("message")
-        if m is not None:
-            self._handle_message_batch(pb.MessageBatch(
-                requests=(m,),
-                deployment_id=self.config.deployment_id,
-                source_address=chunk.get("source_address", ""),
-            ))
-        return True
+    def _on_snapshot_reassembled(self, m: pb.Message,
+                                 source_address: str) -> None:
+        """A chunk stream completed: deliver the rebuilt InstallSnapshot
+        (chunk.go:106 → nodehost.go:2072 handoff).  The sender address rides
+        chunk 0 so a joining replica can respond before any membership
+        entry applies locally."""
+        self._handle_message_batch(pb.MessageBatch(
+            requests=(m,), deployment_id=self.config.deployment_id,
+            source_address=source_address))
 
     def _on_unreachable(self, m: pb.Message) -> None:
         with self.mu:

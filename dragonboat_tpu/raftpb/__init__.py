@@ -680,3 +680,79 @@ def entries_to_apply(entries: Sequence[Entry], applied: int) -> Sequence[Entry]:
     if first > applied + 1:
         raise ValueError(f"gap between applied {applied} and first entry {first}")
     return entries[applied + 1 - first :]
+
+
+# ---------------------------------------------------------------------------
+# Snapshot wire chunk — parity raftpb/chunk.go:11 (Chunk).
+#
+# A snapshot transfer is a stream of fixed-size chunks; chunk 0 additionally
+# carries the encoded InstallSnapshot message (metadata + membership) so the
+# receiver can rebuild and deliver it once the file is reassembled.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Chunk:
+    shard_id: int = 0
+    replica_id: int = 0          # target
+    from_: int = 0               # sender replica
+    chunk_id: int = 0
+    chunk_count: int = 0
+    chunk_size: int = 0          # bytes of data in this chunk
+    file_size: int = 0           # total snapshot file size
+    index: int = 0               # snapshot index (transfer identity)
+    term: int = 0
+    deployment_id: int = 0
+    bin_ver: int = 1
+    source_address: str = ""           # sender NodeHost address (chunk 0)
+    data: bytes = b""
+    message: "Message | None" = None   # chunk 0 only
+
+    def is_last(self) -> bool:
+        return self.chunk_id == self.chunk_count - 1
+
+
+_CHUNK_HDR = struct.Struct("<QQQQQQQQQQIII")
+
+
+def encode_chunk(c: Chunk) -> bytes:
+    buf = bytearray()
+    mbuf = bytearray()
+    if c.message is not None:
+        encode_message(c.message, mbuf)
+    src = c.source_address.encode()
+    buf += _CHUNK_HDR.pack(
+        c.shard_id, c.replica_id, c.from_, c.chunk_id, c.chunk_count,
+        c.chunk_size, c.file_size, c.index, c.term, c.deployment_id,
+        len(src), len(mbuf), len(c.data),
+    )
+    buf += src
+    buf += mbuf
+    buf += c.data
+    crc = zlib.crc32(bytes(buf))
+    return struct.pack("<I", crc) + bytes(buf)
+
+
+def decode_chunk(data: bytes) -> Chunk:
+    (crc,) = struct.unpack_from("<I", data, 0)
+    body = memoryview(data)[4:]
+    if zlib.crc32(bytes(body)) != crc:
+        raise ValueError("chunk checksum mismatch")
+    (shard_id, replica_id, from_, chunk_id, chunk_count, chunk_size,
+     file_size, index, term, deployment_id, slen, mlen, dlen) = \
+        _CHUNK_HDR.unpack_from(body, 0)
+    off = _CHUNK_HDR.size
+    src = bytes(body[off:off + slen]).decode()
+    off += slen
+    message = None
+    if mlen:
+        message, _ = decode_message(body, off)
+        off += mlen
+    payload = bytes(body[off:off + dlen])
+    return Chunk(
+        shard_id=shard_id, replica_id=replica_id, from_=from_,
+        chunk_id=chunk_id, chunk_count=chunk_count, chunk_size=chunk_size,
+        file_size=file_size, index=index, term=term,
+        deployment_id=deployment_id, source_address=src, data=payload,
+        message=message,
+    )

@@ -1,0 +1,165 @@
+"""Snapshot chunk streaming: split, reassemble, GC.
+
+Parity with the reference's chunked snapshot transfer
+(``internal/transport/snapshot.go:49,211-217`` sender split,
+``chunk.go:106-194`` receiver ``Chunk.Add`` with per-transfer locks, a
+concurrency cap and tick-based GC of stalled transfers).  The sender reads
+the snapshot file and emits ``raftpb.Chunk`` records; the receiver
+reassembles them into a local file and delivers the original
+InstallSnapshot message (filepath rewritten) once the last chunk lands.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from dragonboat_tpu import raftpb as pb
+
+SNAPSHOT_CHUNK_SIZE = 2 * 1024 * 1024   # snapshot.go:49 snapshotChunkSize
+MAX_CONCURRENT_STREAMS = 128            # chunk.go:42 MaxConcurrentStreaming
+GC_TICKS = 30                           # stalled-transfer timeout in ticks
+
+
+def split_snapshot_message(m: pb.Message, deployment_id: int,
+                           chunk_size: int = SNAPSHOT_CHUNK_SIZE,
+                           source_address: str = ""):
+    """Yield Chunk records for an InstallSnapshot message
+    (snapshot.go:211 SendSnapshot read-and-split)."""
+    ss = m.snapshot
+    file_size = os.path.getsize(ss.filepath) if ss.filepath else 0
+    count = max(1, (file_size + chunk_size - 1) // chunk_size)
+    with open(ss.filepath, "rb") if ss.filepath else _null_file() as f:
+        for cid in range(count):
+            data = f.read(chunk_size)
+            yield pb.Chunk(
+                shard_id=m.shard_id,
+                replica_id=m.to,
+                from_=m.from_,
+                chunk_id=cid,
+                chunk_count=count,
+                chunk_size=len(data),
+                file_size=file_size,
+                index=ss.index,
+                term=ss.term,
+                deployment_id=deployment_id,
+                source_address=source_address if cid == 0 else "",
+                data=data,
+                message=m if cid == 0 else None,
+            )
+
+
+class _null_file:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def read(self, n):
+        return b""
+
+
+@dataclass
+class _Transfer:
+    message: pb.Message | None = None
+    next_chunk: int = 0
+    chunk_count: int = 0
+    path: str = ""
+    fh: object = None
+    idle_ticks: int = 0
+    validated: int = 0
+    source_address: str = ""
+
+
+class ChunkSink:
+    """Receiver-side reassembly — parity chunk.go:106 (Chunk.Add)."""
+
+    def __init__(self, snapshot_dir: str, deployment_id: int,
+                 deliver, max_concurrent: int = MAX_CONCURRENT_STREAMS):
+        """``deliver(message, source_address)`` is called with the rebuilt
+        InstallSnapshot (filepath pointing at the reassembled local file)."""
+        self.dir = snapshot_dir
+        self.deployment_id = deployment_id
+        self.deliver = deliver
+        self.max_concurrent = max_concurrent
+        self.mu = threading.Lock()
+        self.transfers: dict[tuple[int, int, int], _Transfer] = {}
+
+    def add(self, c: pb.Chunk) -> bool:
+        """Returns False when the chunk is rejected (out of order, over the
+        concurrency cap, wrong deployment)."""
+        if c.deployment_id != self.deployment_id:
+            return False
+        key = (c.shard_id, c.replica_id, c.from_)
+        completed = None
+        with self.mu:
+            t = self.transfers.get(key)
+            if c.chunk_id == 0:
+                if t is not None:
+                    self._abort_locked(key)
+                if len(self.transfers) >= self.max_concurrent:
+                    return False
+                if c.message is None:
+                    return False
+                os.makedirs(self.dir, exist_ok=True)
+                path = os.path.join(
+                    self.dir,
+                    f"incoming-{c.shard_id:016X}-{c.replica_id:016X}"
+                    f"-{c.index:016X}.gbsnap",
+                )
+                t = _Transfer(message=c.message, chunk_count=c.chunk_count,
+                              path=path, fh=open(path, "wb"),
+                              source_address=c.source_address)
+                self.transfers[key] = t
+            elif t is None or c.chunk_id != t.next_chunk:
+                # out-of-order/stale chunk: drop the whole transfer
+                if t is not None:
+                    self._abort_locked(key)
+                return False
+            t.idle_ticks = 0
+            t.fh.write(c.data)
+            t.validated += len(c.data)
+            t.next_chunk = c.chunk_id + 1
+            if c.is_last():
+                t.fh.close()
+                if c.file_size and t.validated != c.file_size:
+                    os.remove(t.path)
+                    del self.transfers[key]
+                    return False
+                del self.transfers[key]
+                completed = t
+        if completed is not None:
+            # deliver OUTSIDE the lock: dispatch recurses into the whole
+            # nodehost message path and must not serialize other transfers
+            m = completed.message
+            from dataclasses import replace
+            m = replace(m, snapshot=replace(m.snapshot,
+                                            filepath=completed.path))
+            self.deliver(m, completed.source_address)
+        return True
+
+    def _abort_locked(self, key) -> None:
+        t = self.transfers.pop(key, None)
+        if t is not None and t.fh is not None:
+            try:
+                t.fh.close()
+                os.remove(t.path)
+            except OSError:
+                pass
+
+    def tick(self) -> None:
+        """Advance the GC clock; drop stalled transfers (chunk.go GC)."""
+        with self.mu:
+            stalled = []
+            for key, t in self.transfers.items():
+                t.idle_ticks += 1
+                if t.idle_ticks >= GC_TICKS:
+                    stalled.append(key)
+            for key in stalled:
+                self._abort_locked(key)
+
+    def inflight(self) -> int:
+        with self.mu:
+            return len(self.transfers)

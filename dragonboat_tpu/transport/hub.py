@@ -74,6 +74,8 @@ class TransportHub:
         message — Send (transport.go:115-136)."""
         if m.is_local():
             raise AssertionError("local message sent to transport")
+        if m.type == pb.MessageType.INSTALL_SNAPSHOT:
+            return self.send_snapshot(m)
         try:
             addr, _key = self.resolver.resolve(m.shard_id, m.to)
         except KeyError:
@@ -117,20 +119,59 @@ class TransportHub:
                 for m in msgs:
                     self._notify_unreachable(m)
 
+    def send_snapshot(self, m: pb.Message) -> bool:
+        """Stream an InstallSnapshot in a background job — the reference
+        runs snapshot sends in a dedicated job pool (snapshot.go:211,
+        job.go:43-69); blocking the engine thread here would stall every
+        shard's ticks for the duration of a transfer."""
+        from dragonboat_tpu.transport.chunks import split_snapshot_message
+
+        def job() -> None:
+            self.send_snapshot_chunks(
+                m, split_snapshot_message(m, self.deployment_id,
+                                          source_address=self.source_address))
+
+        threading.Thread(target=job, name="snapshot-stream",
+                         daemon=True).start()
+        return True
+
     def send_snapshot_chunks(self, m: pb.Message, chunks) -> bool:
         """Send an InstallSnapshot as a chunk stream (snapshot.go:211)."""
         try:
             addr, _ = self.resolver.resolve(m.shard_id, m.to)
         except KeyError:
+            self._notify_snapshot_failed(m)
+            return False
+        b = self.breaker(addr)
+        if not b.ready():
+            self._notify_snapshot_failed(m)
             return False
         try:
             conn = self.transport.get_snapshot_connection(addr)
             for c in chunks:
                 conn.send_chunk(c)
+            b.succeed()
+            self.metrics["snapshots_sent"] = (
+                self.metrics.get("snapshots_sent", 0) + 1)
             return True
         except Exception:
+            b.fail()
             self._notify_unreachable(m)
+            self._notify_snapshot_failed(m)
             return False
+
+    def _notify_snapshot_failed(self, m: pb.Message) -> None:
+        """Feed a rejected SnapshotStatus back to the sender's raft
+        (transport failure → raft.go:1136 handleLeaderSnapshotStatus)."""
+        self.unreachable_cb(
+            pb.Message(
+                type=pb.MessageType.SNAPSHOT_STATUS,
+                from_=m.to,
+                to=m.from_,
+                shard_id=m.shard_id,
+                reject=True,
+            )
+        )
 
     def _notify_unreachable(self, m: pb.Message) -> None:
         self.unreachable_cb(

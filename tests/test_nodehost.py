@@ -172,12 +172,22 @@ def test_leader_transfer(cluster):
     hosts = cluster
     lid = wait_leader(hosts)
     target = next(r for r in hosts if r != lid)
-    hosts[lid].request_leader_transfer(1, target)
-    deadline = time.time() + 5
+    # a transfer aborts if the target lags an election timeout behind
+    # (raft.go leader-transfer abort); retry like the reference's tests do
+    deadline = time.time() + 10
+    next_request = 0.0
     while time.time() < deadline:
         nlid, ok = hosts[target].get_leader_id(1)
         if ok and nlid == target:
             break
+        if time.time() >= next_request:
+            lid2, ok2 = hosts[target].get_leader_id(1)
+            if ok2 and lid2 in hosts:
+                try:
+                    hosts[lid2].request_leader_transfer(1, target)
+                except Exception:
+                    pass
+            next_request = time.time() + 1.0
         time.sleep(0.02)
     assert hosts[target].get_leader_id(1)[0] == target
 
