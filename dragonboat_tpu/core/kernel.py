@@ -236,11 +236,32 @@ def _become_follower(s, mask, term, leader, reset_timeout=True):
     return mrep(s, mask, role=new_role, leader=leader)
 
 
+def _set1(arr, idx, val, mask):
+    """TPU-safe masked write of one dynamic slot: arr[idx] = val where mask.
+
+    vmapped scalar-index ``.at[i].set`` lowers to a batched scatter, and on
+    TPU (jax 0.9.0, v5e) that scatter SILENTLY DROPS writes for sub-32-bit
+    element types (bool/int8/int16) once the batch axis exceeds ~3k rows
+    with non-uniform indices.  A one-hot select avoids scatter entirely —
+    and vectorizes better on the VPU anyway, so it is also the faster
+    lowering for the small [P]/[RI]/ring axes this kernel uses."""
+    n = arr.shape[0]
+    oh = (jnp.arange(n, dtype=I32) == idx) & mask
+    return jnp.where(oh, val, arr)
+
+
+def _set_row(arr, idx, val, mask):
+    """Row variant of _set1: arr[idx, :] = val where mask (arr [N, P])."""
+    n = arr.shape[0]
+    oh = (jnp.arange(n, dtype=I32) == idx) & mask
+    return jnp.where(oh[:, None], val, arr)
+
+
 def _append_one(kp, s: ShardState, mask, term, is_cc) -> ShardState:
     idx = s.last + 1
     slot = _slot(kp, idx)
-    lt = s.lt.at[slot].set(sel(mask, term, s.lt[slot]))
-    lcc = s.lcc.at[slot].set(sel(mask, is_cc, s.lcc[slot]))
+    lt = _set1(s.lt, slot, term, mask)
+    lcc = _set1(s.lcc, slot, is_cc, mask)
     s = s._replace(lt=lt, lcc=lcc)
     return mrep(s, mask, last=idx)
 
@@ -315,12 +336,10 @@ def _ri_push(kp, s: ShardState, mask, low, high, index):
     pos = (s.ri_head + s.ri_count) & (RI - 1)
     do = mask & ~full
     s = s._replace(
-        ri_low=s.ri_low.at[pos].set(sel(do, low, s.ri_low[pos])),
-        ri_high=s.ri_high.at[pos].set(sel(do, high, s.ri_high[pos])),
-        ri_index=s.ri_index.at[pos].set(sel(do, index, s.ri_index[pos])),
-        ri_acks=s.ri_acks.at[pos].set(
-            sel(do, jnp.zeros_like(s.ri_acks[pos]), s.ri_acks[pos])
-        ),
+        ri_low=_set1(s.ri_low, pos, low, do),
+        ri_high=_set1(s.ri_high, pos, high, do),
+        ri_index=_set1(s.ri_index, pos, index, do),
+        ri_acks=_set_row(s.ri_acks, pos, jnp.zeros_like(s.ri_acks[pos]), do),
     )
     s = mrep(s, do, ri_count=s.ri_count + 1)
     # a full book drops the request (host will retry) — bounded-memory analog
@@ -339,10 +358,10 @@ def _ri_confirm(kp, s: ShardState, eff: Effects, mask, low, high, sender_slot):
     hit = live & (s.ri_low == low) & (s.ri_high == high)
     hit_any = mask & jnp.any(hit)
     hit_slot = jnp.argmax(hit)
-    acks = s.ri_acks.at[hit_slot, sender_slot].set(
-        sel(hit_any, True, s.ri_acks[hit_slot, sender_slot])
-    )
-    s = s._replace(ri_acks=acks)
+    P_ = s.ri_acks.shape[1]
+    oh2 = ((jnp.arange(RI, dtype=I32) == hit_slot)[:, None]
+           & (jnp.arange(P_, dtype=I32) == sender_slot)[None, :] & hit_any)
+    s = s._replace(ri_acks=jnp.where(oh2, True, s.ri_acks))
     n_acks = jnp.sum(s.ri_acks[hit_slot].astype(I32))
     quorum_ok = hit_any & (n_acks + 1 >= _quorum(s))
     pop_n = sel(quorum_ok, qpos[hit_slot] + 1, 0)
@@ -464,14 +483,19 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     # append entries from the first conflicting lane on
     do_append = accept & any_conflict
     append_from_lane = first_conflict
-    # ring writes for lanes >= first_conflict (and live)
+    # ring writes for lanes >= first_conflict (and live) — scatter-free:
+    # each ring slot gathers its (consecutive mod cap) message lane instead
+    # of the lanes scattering into the ring (see _set1 on why TPU scatters
+    # are off-limits here; the gather form also fuses better)
     write_lane = ent_live & (jnp.arange(E, dtype=I32) >= append_from_lane)
-    widx = ent_idx
-    wslot = _slot(kp, widx)
     wmask = do_append & write_lane
+    cap = s.lt.shape[0]
+    rel = (jnp.arange(cap, dtype=I32) - _slot(kp, m.log_index + 1)) & (cap - 1)
+    lane_of_slot = jnp.minimum(rel, E - 1)
+    slot_written = (rel < E) & wmask[lane_of_slot]
     s = s._replace(
-        lt=s.lt.at[wslot].set(sel(wmask, m.ent_term, s.lt[wslot])),
-        lcc=s.lcc.at[wslot].set(sel(wmask, m.ent_cc, s.lcc[wslot])),
+        lt=jnp.where(slot_written, m.ent_term[lane_of_slot], s.lt),
+        lcc=jnp.where(slot_written, m.ent_cc[lane_of_slot], s.lcc),
     )
     new_last_if_append = m.log_index + m.n_ent
     s = mrep(s, do_append, last=new_last_if_append,
@@ -527,10 +551,8 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     h_vr = h_vr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
     not_seen = ~s.vresp[sender_slot]
     s = s._replace(
-        vresp=s.vresp.at[sender_slot].set(sel(h_vr, True, s.vresp[sender_slot])),
-        vgrant=s.vgrant.at[sender_slot].set(
-            sel(h_vr & not_seen, ~m.reject, s.vgrant[sender_slot])
-        ),
+        vresp=_set1(s.vresp, sender_slot, True, h_vr),
+        vgrant=_set1(s.vgrant, sender_slot, ~m.reject, h_vr & not_seen),
     )
     votes_for = jnp.sum(s.vgrant.astype(I32))
     votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
@@ -545,10 +567,8 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     h_pvr = h_pvr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
     not_seen = ~s.vresp[sender_slot]
     s = s._replace(
-        vresp=s.vresp.at[sender_slot].set(sel(h_pvr, True, s.vresp[sender_slot])),
-        vgrant=s.vgrant.at[sender_slot].set(
-            sel(h_pvr & not_seen, ~m.reject, s.vgrant[sender_slot])
-        ),
+        vresp=_set1(s.vresp, sender_slot, True, h_pvr),
+        vgrant=_set1(s.vgrant, sender_slot, ~m.reject, h_pvr & not_seen),
     )
     votes_for = jnp.sum(s.vgrant.astype(I32))
     votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
@@ -557,8 +577,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
 
     # ---- ReplicateResp (leader; raft.go:1878) ----
     h_rr = act & is_leader & (mtype == MT.REPLICATE_RESP) & sender_known
-    s = s._replace(active=s.active.at[sender_slot].set(
-        sel(h_rr, True, s.active[sender_slot])))
+    s = s._replace(active=_set1(s.active, sender_slot, True, h_rr))
     old_match = s.match[sender_slot]
     old_next = s.next[sender_slot]
     old_pstate = s.pstate[sender_slot]
@@ -567,12 +586,9 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     ok_resp = h_rr & ~m.reject
     updated = ok_resp & (old_match < m.log_index)
     s = s._replace(
-        next=s.next.at[sender_slot].set(
-            sel(ok_resp, jnp.maximum(old_next, m.log_index + 1), old_next)
-        ),
-        match=s.match.at[sender_slot].set(
-            sel(updated, m.log_index, old_match)
-        ),
+        next=_set1(s.next, sender_slot,
+                   jnp.maximum(old_next, m.log_index + 1), ok_resp),
+        match=_set1(s.match, sender_slot, m.log_index, updated),
     )
     # wait_to_retry then respondedTo: retry→replicate; snapshot→retry if caught up
     ps = s.pstate[sender_slot]
@@ -581,11 +597,9 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     snap_caught = s.match[sender_slot] >= s.psnap[sender_slot]
     ps = sel(updated & (ps == P.R_SNAPSHOT) & snap_caught, P.R_RETRY, ps)
     s = s._replace(
-        pstate=s.pstate.at[sender_slot].set(sel(h_rr, ps, old_pstate)),
-        psnap=s.psnap.at[sender_slot].set(
-            sel(updated & (old_pstate == P.R_SNAPSHOT) & snap_caught,
-                0, s.psnap[sender_slot])
-        ),
+        pstate=_set1(s.pstate, sender_slot, ps, h_rr),
+        psnap=_set1(s.psnap, sender_slot, 0,
+                    updated & (old_pstate == P.R_SNAPSHOT) & snap_caught),
     )
     committed_before = s.committed
     s = jax.tree_util.tree_map(
@@ -596,15 +610,13 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     eff = eff._replace(
         need_rep=sel(
             updated & commit_advanced, jnp.ones_like(eff.need_rep),
-            eff.need_rep.at[sender_slot].set(
-                eff.need_rep[sender_slot] | (updated & ~commit_advanced & paused)
-            ),
+            _set1(eff.need_rep, sender_slot, True,
+                  updated & ~commit_advanced & paused),
         )
     )
     # leadership transfer: target caught up → TimeoutNow (raft.go:1893)
     tn = updated & (s.ltt == m.from_) & (s.match[sender_slot] == s.last)
-    eff = eff._replace(send_tn=eff.send_tn.at[sender_slot].set(
-        eff.send_tn[sender_slot] | tn))
+    eff = eff._replace(send_tn=_set1(eff.send_tn, sender_slot, True, tn))
     # reject: decreaseTo (remote.go:decreaseTo) + resend
     rej = h_rr & m.reject
     in_replicate = old_pstate == P.R_REPLICATE
@@ -615,29 +627,25 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
         jnp.maximum(1, jnp.minimum(m.log_index, m.hint + 1)),
     )
     dec = dec_ok_rep | dec_ok_probe
+    dec_ps = sel(dec_ok_rep, P.R_RETRY,
+                 sel(dec_ok_probe & (s.pstate[sender_slot] == P.R_WAIT),
+                     P.R_RETRY, s.pstate[sender_slot]))
     s = s._replace(
-        next=s.next.at[sender_slot].set(sel(dec, new_next, s.next[sender_slot])),
-        pstate=s.pstate.at[sender_slot].set(
-            sel(dec_ok_rep, P.R_RETRY,
-                sel(dec_ok_probe & (s.pstate[sender_slot] == P.R_WAIT),
-                    P.R_RETRY, s.pstate[sender_slot]))
-        ),
+        next=_set1(s.next, sender_slot, new_next, dec),
+        pstate=_set1(s.pstate, sender_slot, dec_ps, h_rr),
     )
-    eff = eff._replace(need_rep=eff.need_rep.at[sender_slot].set(
-        eff.need_rep[sender_slot] | dec))
+    eff = eff._replace(need_rep=_set1(eff.need_rep, sender_slot, True, dec))
 
     # ---- HeartbeatResp (leader; raft.go:1912) ----
     h_hr = act & is_leader & (mtype == MT.HEARTBEAT_RESP) & sender_known
     s = s._replace(
-        active=s.active.at[sender_slot].set(sel(h_hr, True, s.active[sender_slot])),
-        pstate=s.pstate.at[sender_slot].set(
-            sel(h_hr & (s.pstate[sender_slot] == P.R_WAIT), P.R_RETRY,
-                s.pstate[sender_slot])
-        ),
+        active=_set1(s.active, sender_slot, True, h_hr),
+        pstate=_set1(s.pstate, sender_slot, P.R_RETRY,
+                     h_hr & (s.pstate[sender_slot] == P.R_WAIT)),
     )
     lagging = s.match[sender_slot] < s.last
-    eff = eff._replace(need_rep=eff.need_rep.at[sender_slot].set(
-        eff.need_rep[sender_slot] | (h_hr & lagging)))
+    eff = eff._replace(need_rep=_set1(eff.need_rep, sender_slot, True,
+                                      h_hr & lagging))
     conf = h_hr & (m.hint != 0)
     s_c, eff_c = _ri_confirm(kp, s, eff, conf, m.hint, m.hint_high, sender_slot)
     s = jax.tree_util.tree_map(lambda a, b: sel(conf, a, b), s_c, s)
@@ -651,9 +659,9 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
 
     # ---- Unreachable (leader; raft.go:1997) ----
     h_un = act & is_leader & (mtype == MT.UNREACHABLE) & sender_known
-    s = s._replace(pstate=s.pstate.at[sender_slot].set(
-        sel(h_un & (s.pstate[sender_slot] == P.R_REPLICATE), P.R_RETRY,
-            s.pstate[sender_slot])))
+    s = s._replace(pstate=_set1(
+        s.pstate, sender_slot, P.R_RETRY,
+        h_un & (s.pstate[sender_slot] == P.R_REPLICATE)))
 
     # ---- SnapshotStatus (leader, immediate variant; raft.go:1975) ----
     h_ss = act & is_leader & (mtype == MT.SNAPSHOT_STATUS) & sender_known
@@ -664,12 +672,9 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
         jnp.maximum(s.match[sender_slot] + 1, s.psnap[sender_slot] + 1),
     )
     s = s._replace(
-        next=s.next.at[sender_slot].set(
-            sel(h_ss & in_snap, nn, s.next[sender_slot])),
-        psnap=s.psnap.at[sender_slot].set(
-            sel(h_ss & in_snap, 0, s.psnap[sender_slot])),
-        pstate=s.pstate.at[sender_slot].set(
-            sel(h_ss & in_snap, P.R_WAIT, s.pstate[sender_slot])),
+        next=_set1(s.next, sender_slot, nn, h_ss & in_snap),
+        psnap=_set1(s.psnap, sender_slot, 0, h_ss & in_snap),
+        pstate=_set1(s.pstate, sender_slot, P.R_WAIT, h_ss & in_snap),
     )
 
     resp = (r_type, r_to, r_term, r_log_index, r_reject, r_hint, r_hint_high)
@@ -712,10 +717,10 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     fast = ri_req & single
     lane = jnp.minimum(eff.rtr_n, RI - 1)
     eff = eff._replace(
-        rtr_valid=eff.rtr_valid.at[lane].set(sel(fast, True, eff.rtr_valid[lane])),
-        rtr_index=eff.rtr_index.at[lane].set(sel(fast, s.committed, eff.rtr_index[lane])),
-        rtr_low=eff.rtr_low.at[lane].set(sel(fast, inp.ri_low, eff.rtr_low[lane])),
-        rtr_high=eff.rtr_high.at[lane].set(sel(fast, inp.ri_high, eff.rtr_high[lane])),
+        rtr_valid=_set1(eff.rtr_valid, lane, True, fast),
+        rtr_index=_set1(eff.rtr_index, lane, s.committed, fast),
+        rtr_low=_set1(eff.rtr_low, lane, inp.ri_low, fast),
+        rtr_high=_set1(eff.rtr_high, lane, inp.ri_high, fast),
         rtr_n=eff.rtr_n + sel(fast, 1, 0),
     )
     quorum_path = ri_req & ~single & has_cur_term_commit
@@ -776,8 +781,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     do_tr = tr_req & tr_known
     s = mrep(s, do_tr, ltt=tr, e_tick=0)
     fast_tn = do_tr & (s.match[tr_slot] == s.last)
-    eff = eff._replace(send_tn=eff.send_tn.at[tr_slot].set(
-        eff.send_tn[tr_slot] | fast_tn))
+    eff = eff._replace(send_tn=_set1(eff.send_tn, tr_slot, True, fast_tn))
 
     # 5. tick (raft.go:571-655)
     is_leader = s.role == P.LEADER  # refresh (campaigns can't happen above)
