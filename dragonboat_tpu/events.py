@@ -1,0 +1,156 @@
+"""Event publication + metrics — parity with the reference's ``event.go``.
+
+User-provided listeners (raftio.IRaftEventListener / ISystemEventListener)
+are invoked from a dedicated worker thread so a slow listener can never
+stall the engine (event.go:54-90 runs listeners on the events goroutine).
+Exceptions from listeners are logged and swallowed.
+
+Metrics: a process-wide counter registry analogous to the reference's
+Prometheus surface (event.go metrics + nodehost metrics); exported as a
+plain dict snapshot so any exporter can scrape it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+from typing import Callable
+
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.raftio import (
+    EntryInfo,
+    LeaderInfo,
+    NodeInfo,
+    SnapshotInfo,
+)
+
+_LOG = get_logger("events")
+
+
+class Metrics:
+    """Process-wide counters (reference: Prometheus registry)."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()
+        self.counters: dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        with self.mu:
+            self.counters[name] += delta
+
+    def set(self, name: str, value: int) -> None:
+        with self.mu:
+            self.counters[name] = value
+
+    def snapshot(self) -> dict[str, int]:
+        with self.mu:
+            return dict(self.counters)
+
+
+class EventHub:
+    """Queue-decoupled listener dispatch (event.go:54-90)."""
+
+    def __init__(self, raft_listener=None, system_listener=None,
+                 metrics: Metrics | None = None) -> None:
+        self.raft_listener = raft_listener
+        self.system_listener = system_listener
+        self.metrics = metrics or Metrics()
+        self._q: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+        if raft_listener is not None or system_listener is not None:
+            self._worker = threading.Thread(
+                target=self._run, name="events", daemon=True)
+            self._worker.start()
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join(timeout=2)
+            self._worker = None
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:
+                _LOG.exception("event listener raised")
+
+    def _dispatch(self, listener, method: str, *args) -> None:
+        if listener is None:
+            return
+        fn: Callable | None = getattr(listener, method, None)
+        if fn is None:
+            return
+        self._q.put((fn, args))
+
+    # -- raft events (listener.go:33) -----------------------------------
+
+    def leader_updated(self, info: LeaderInfo) -> None:
+        self.metrics.inc("raft.leader_updated")
+        self._dispatch(self.raft_listener, "leader_updated", info)
+
+    # -- system events (listener.go:59-76) ------------------------------
+
+    def node_host_shutting_down(self) -> None:
+        self._dispatch(self.system_listener, "node_host_shutting_down")
+
+    def node_unloaded(self, info: NodeInfo) -> None:
+        self._dispatch(self.system_listener, "node_unloaded", info)
+
+    def node_deleted(self, info: NodeInfo) -> None:
+        self._dispatch(self.system_listener, "node_deleted", info)
+
+    def node_ready(self, info: NodeInfo) -> None:
+        self.metrics.inc("system.node_ready")
+        self._dispatch(self.system_listener, "node_ready", info)
+
+    def membership_changed(self, info: NodeInfo) -> None:
+        self.metrics.inc("system.membership_changed")
+        self._dispatch(self.system_listener, "membership_changed", info)
+
+    def connection_established(self, addr: str, snapshot: bool) -> None:
+        self.metrics.inc("transport.connection_established")
+        self._dispatch(self.system_listener, "connection_established",
+                       addr, snapshot)
+
+    def connection_failed(self, addr: str, snapshot: bool) -> None:
+        self.metrics.inc("transport.connection_failed")
+        self._dispatch(self.system_listener, "connection_failed",
+                       addr, snapshot)
+
+    def send_snapshot_started(self, info: SnapshotInfo) -> None:
+        self._dispatch(self.system_listener, "send_snapshot_started", info)
+
+    def send_snapshot_completed(self, info: SnapshotInfo) -> None:
+        self._dispatch(self.system_listener, "send_snapshot_completed", info)
+
+    def send_snapshot_aborted(self, info: SnapshotInfo) -> None:
+        self._dispatch(self.system_listener, "send_snapshot_aborted", info)
+
+    def snapshot_received(self, info: SnapshotInfo) -> None:
+        self.metrics.inc("snapshot.received")
+        self._dispatch(self.system_listener, "snapshot_received", info)
+
+    def snapshot_recovered(self, info: SnapshotInfo) -> None:
+        self.metrics.inc("snapshot.recovered")
+        self._dispatch(self.system_listener, "snapshot_recovered", info)
+
+    def snapshot_created(self, info: SnapshotInfo) -> None:
+        self.metrics.inc("snapshot.created")
+        self._dispatch(self.system_listener, "snapshot_created", info)
+
+    def snapshot_compacted(self, info: SnapshotInfo) -> None:
+        self._dispatch(self.system_listener, "snapshot_compacted", info)
+
+    def log_compacted(self, info: EntryInfo) -> None:
+        self.metrics.inc("log.compacted")
+        self._dispatch(self.system_listener, "log_compacted", info)
+
+    def log_db_compacted(self, info: EntryInfo) -> None:
+        self.metrics.inc("logdb.compacted")
+        self._dispatch(self.system_listener, "log_db_compacted", info)

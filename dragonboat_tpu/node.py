@@ -18,8 +18,11 @@ from dragonboat_tpu.config import Config
 from dragonboat_tpu.core.logentry import CompactedError
 from dragonboat_tpu.core.peer import Peer
 from dragonboat_tpu.core.pycore import CoreConfig, Raft
+from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.logdb.logreader import LogReader
-from dragonboat_tpu.raftio import ILogDB
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.quiesce import QuiesceState
+from dragonboat_tpu.raftio import EntryInfo, ILogDB, LeaderInfo, SnapshotInfo
 from dragonboat_tpu.request import (
     PendingProposal,
     PendingReadIndex,
@@ -29,6 +32,8 @@ from dragonboat_tpu.request import (
 )
 from dragonboat_tpu.rsm.statemachine import StateMachine
 from dragonboat_tpu.statemachine import Result
+
+_LOG = get_logger("node")
 
 
 @dataclass
@@ -49,6 +54,7 @@ class Node:
         send_message,          # Callable[[pb.Message], None]
         snapshot_dir: str,
         rng=None,
+        events: EventHub | None = None,
     ) -> None:
         self.cfg = cfg
         self.shard_id = cfg.shard_id
@@ -57,6 +63,7 @@ class Node:
         self.sm = sm
         self.send_message = send_message
         self.snapshot_dir = snapshot_dir
+        self.events = events or EventHub()
         self.mu = threading.RLock()
         self.log_reader = LogReader(cfg.shard_id, cfg.replica_id, logdb)
 
@@ -65,12 +72,35 @@ class Node:
         self.pending_config_change = PendingSingleton()
         self.pending_snapshot = PendingSingleton()
         self.pending_transfer = PendingSingleton()
+        self.pending_log_query = PendingSingleton()
 
         self.incoming_msgs: list[pb.Message] = []
         self.incoming_proposals: list[pb.Entry] = []
         self.transfer_target: int | None = None
         self.config_change_entry: pb.Entry | None = None
         self.snapshot_request: _SnapshotRequest | None = None
+        self.log_query_range: tuple[int, int, int] | None = None
+        self.compaction_request_key: int | None = None
+        self.pending_compaction = PendingSingleton()
+
+        # quiesce bookkeeping (quiesce.go:24, node.go:195)
+        self.qs = QuiesceState(
+            shard_id=cfg.shard_id,
+            replica_id=cfg.replica_id,
+            election_tick=cfg.election_rtt,
+            enabled=cfg.quiesce,
+        )
+        # leader-transfer completion (target, request key): the reference's
+        # transfer is fire-and-forget (request.go:564); our future completes
+        # on the LeaderUpdate that lands the target, timing out otherwise.
+        # The key is captured at request time so a stale edge can never
+        # complete a later, unrelated transfer request.
+        self._transfer_awaiting: tuple[int, int] | None = None
+        # last observed (leader, term): pycore emits a LeaderUpdate on every
+        # follower heartbeat, so leader changes must be edge-detected here
+        self._last_leader: tuple[int, int] = (0, 0)
+        # requestCompaction seam (node.go:972 getCompactedTo)
+        self.compacted_to = 0
 
         self.peer: Peer | None = None
         self.stopped = False
@@ -127,6 +157,10 @@ class Node:
                 self.sm.members.set(ss.membership)
                 self.sm.last_applied = max(self.sm.last_applied, ss.index)
                 self.sm.last_applied_term = ss.term
+                # re-seed the compaction cursor so RequestCompaction keeps
+                # working across restarts (ss.getCompactedTo analog)
+                self.compacted_to = max(
+                    0, ss.index - self.cfg.compaction_overhead)
         else:
             self.peer = Peer.launch(
                 ccfg, self.log_reader, initial_members, initial, new_node,
@@ -151,7 +185,8 @@ class Node:
         self.stopped = True
         for book in (self.pending_proposals, self.pending_reads,
                      self.pending_config_change, self.pending_snapshot,
-                     self.pending_transfer):
+                     self.pending_transfer, self.pending_log_query,
+                     self.pending_compaction):
             book.terminate_all()
         self.sm.close()
 
@@ -188,9 +223,28 @@ class Node:
 
     def request_leader_transfer(self, target: int,
                                 timeout_ticks: int) -> RequestState:
-        rs, _key = self.pending_transfer.request(timeout_ticks)
+        rs, key = self.pending_transfer.request(timeout_ticks)
         with self.mu:
             self.transfer_target = target
+            self._transfer_awaiting = (target, key)
+        return rs
+
+    def query_raft_log(self, first: int, last: int, max_size: int,
+                       timeout_ticks: int) -> RequestState:
+        """QueryRaftLog through the engine path (node.go:517 → 1239
+        handleLogQuery): the request rides the step loop; the result lands
+        on the returned RequestState as ``log_query_result``."""
+        rs, _key = self.pending_log_query.request(timeout_ticks)
+        with self.mu:
+            self.log_query_range = (first, last, max_size)
+        return rs
+
+    def request_compaction(self, timeout_ticks: int) -> RequestState:
+        """RequestCompaction (node.go:972): LogDB-level compaction up to
+        the snapshotter's compacted-to index, on the engine thread."""
+        rs, key = self.pending_compaction.request(timeout_ticks)
+        with self.mu:
+            self.compaction_request_key = key
         return rs
 
     def request_snapshot(self, req: _SnapshotRequest | None,
@@ -212,7 +266,8 @@ class Node:
                 pb.Message(type=pb.MessageType.LOCAL_TICK))
         for book in (self.pending_proposals, self.pending_reads,
                      self.pending_config_change, self.pending_snapshot,
-                     self.pending_transfer):
+                     self.pending_transfer, self.pending_log_query,
+                     self.pending_compaction):
             book.advance()
             book.gc()
 
@@ -228,38 +283,62 @@ class Node:
             cc_entry, self.config_change_entry = self.config_change_entry, None
             transfer, self.transfer_target = self.transfer_target, None
             ss_req, self.snapshot_request = self.snapshot_request, None
+            lq, self.log_query_range = self.log_query_range, None
+            compact_key, self.compaction_request_key = (
+                self.compaction_request_key, None)
 
         # 1. read index batch (node.go:1296)
         ctx = self.pending_reads.peep()
         if ctx is not None:
+            self.qs.record(pb.MessageType.READ_INDEX)
             peer.read_index(ctx)
         # 2. received messages (incl. ticks)
         for m in msgs:
             if m.type == pb.MessageType.LOCAL_TICK:
-                if self.cfg.quiesce:
-                    peer.tick()  # quiesce manager integration later
+                # quiesce-aware tick (node.go:1562-1573): a quiesced shard
+                # only advances the logical clock — no heartbeats/elections
+                self.qs.tick()
+                if self.qs.quiesced():
+                    peer.quiesced_tick()
                 else:
                     peer.tick()
+            elif m.type == pb.MessageType.QUIESCE:
+                self.qs.try_enter_quiesce()
             elif m.type == pb.MessageType.INSTALL_SNAPSHOT:
+                self.qs.record(m.type)
                 self._handle_install_snapshot(m)
             elif m.is_local():
                 # locally-generated signals (Unreachable, SnapshotStatus, …)
                 # bypass the external-message gate (node.go:1347-1400)
                 peer.raft.handle(m)
             else:
+                self.qs.record(m.type)
                 peer.handle(m)
         # 3. config change (node.go:1310)
         if cc_entry is not None:
+            self.qs.record(pb.MessageType.CONFIG_CHANGE_EVENT)
             peer.propose_entries([cc_entry])
         # 4. proposals (node.go:1275)
         if props:
+            self.qs.record(pb.MessageType.PROPOSE)
             peer.propose_entries(props)
         # 5. leader transfer
         if transfer is not None:
-            peer.request_leader_transfer(transfer)
+            self.qs.record(pb.MessageType.LEADER_TRANSFER)
+            self._start_leader_transfer(transfer)
         # 6. snapshot request
         if ss_req is not None:
             self._take_snapshot(ss_req)
+        # 7. raft log query (node.go:1238 handleLogQuery)
+        if lq is not None:
+            peer.query_raft_log(*lq)
+        # 8. LogDB compaction request (node.go:972 requestCompaction)
+        if compact_key is not None:
+            self._process_compaction(compact_key)
+        # entering quiesce propagates to peers so the whole group goes
+        # quiet together (node.go:1148 sendEnterQuiesceMessages)
+        if self.qs.new_quiesce_state():
+            self._send_enter_quiesce_messages()
 
         if not peer.has_update(True):
             return False
@@ -271,6 +350,14 @@ class Node:
     # -- update processing (engine.go:1304 processSteps order) -------------
 
     def _process_update(self, ud: pb.Update) -> None:
+        # leader change: listener event + transfer-future completion
+        # (node.go:308 processLeaderUpdate)
+        if ud.leader_update is not None:
+            self._on_leader_update(ud.leader_update)
+        # raft log query result (node.go:319 processLogQuery)
+        lqr = ud.log_query_result
+        if lqr.last_index > 0 or lqr.error != 0:
+            self._on_log_query_result(lqr)
         # send replicate messages BEFORE the fsync (thesis §10.2.1,
         # engine.go:1332-1336)
         for m in ud.messages:
@@ -343,6 +430,86 @@ class Node:
     def membership_changed_cb(self, cc: pb.ConfigChange) -> None:
         """Overridden by NodeHost to update the registry."""
 
+    # -- engine-path op completion ---------------------------------------
+
+    def _start_leader_transfer(self, target: int) -> None:
+        """Submit the transfer, completing the future immediately for the
+        raft-core no-op cases (pycore handle_leader_transfer: target is
+        already leader / unknown / a transfer already in flight) so the
+        one-slot book is not locked out for the whole timeout."""
+        assert self.peer is not None
+        raft = self.peer.raft
+        if target == raft.leader_id or (
+                raft.is_leader() and target == self.replica_id):
+            self._finish_transfer(RequestResultCode.COMPLETED, target)
+            return
+        if raft.is_leader() and (
+                raft.leader_transfering() or target not in raft.remotes):
+            self._finish_transfer(RequestResultCode.REJECTED)
+            return
+        self.peer.request_leader_transfer(target)
+
+    def _finish_transfer(self, code: RequestResultCode,
+                         target: int = 0) -> None:
+        with self.mu:
+            awaiting, self._transfer_awaiting = self._transfer_awaiting, None
+        if awaiting is not None:
+            self.pending_transfer.done(awaiting[1], code,
+                                       Result(value=target))
+
+    def _on_leader_update(self, lu: pb.LeaderUpdate) -> None:
+        if (lu.leader_id, lu.term) == self._last_leader:
+            return  # steady-state heartbeat echo, not a change
+        self._last_leader = (lu.leader_id, lu.term)
+        self.events.leader_updated(LeaderInfo(
+            shard_id=self.shard_id, replica_id=self.replica_id,
+            term=lu.term, leader_id=lu.leader_id,
+        ))
+        if lu.leader_id == 0:
+            # step-down notification mid-transfer — the new leader is not
+            # known yet; keep the future pending until it is
+            return
+        with self.mu:
+            awaiting = self._transfer_awaiting
+        if awaiting is None:
+            return
+        # only a leader edge landing the TARGET resolves the future; an
+        # unrelated re-election mid-transfer leaves it pending (raft's
+        # transfer may still land — the timeout is the failure signal,
+        # matching the reference's fire-and-forget semantics)
+        if lu.leader_id == awaiting[0]:
+            self._finish_transfer(RequestResultCode.COMPLETED, awaiting[0])
+
+    def _on_log_query_result(self, r: pb.LogQueryResult) -> None:
+        rs = self.pending_log_query.outstanding
+        if rs is not None:
+            rs.log_query_result = r
+        code = (RequestResultCode.COMPLETED if r.error == 0
+                else RequestResultCode.REJECTED)
+        self.pending_log_query.done(self.pending_log_query.key, code)
+
+    def _process_compaction(self, key: int) -> None:
+        compact_to = self.compacted_to
+        if compact_to <= 0:
+            self.pending_compaction.done(key, RequestResultCode.REJECTED)
+            return
+        self.logdb.remove_entries_to(self.shard_id, self.replica_id,
+                                     compact_to)
+        self.events.log_db_compacted(EntryInfo(
+            shard_id=self.shard_id, replica_id=self.replica_id,
+            index=compact_to))
+        self.pending_compaction.done(key, RequestResultCode.COMPLETED,
+                                     Result(value=compact_to))
+
+    def _send_enter_quiesce_messages(self) -> None:
+        """node.go:993: tell every peer the shard is going quiet."""
+        for rid in self.sm.get_membership().addresses:
+            if rid != self.replica_id:
+                self._send(pb.Message(
+                    type=pb.MessageType.QUIESCE,
+                    from_=self.replica_id, to=rid, shard_id=self.shard_id,
+                ))
+
     # -- snapshots -------------------------------------------------------
 
     def _snapshot_path(self, index: int) -> str:
@@ -381,6 +548,9 @@ class Node:
             # make the snapshot visible to makeInstallSnapshotMessage
             # (snapshotter.Commit → logReader.CreateSnapshot)
             self.log_reader.create_snapshot(ss)
+            self.events.snapshot_created(SnapshotInfo(
+                shard_id=self.shard_id, replica_id=self.replica_id,
+                from_=self.replica_id, index=index, term=term))
             # compact the log, keeping compaction_overhead entries
             overhead = (req.compaction_overhead if req.override_compaction
                         else self.cfg.compaction_overhead)
@@ -390,8 +560,12 @@ class Node:
                     self.log_reader.compact(compact_to)
                     self.logdb.remove_entries_to(
                         self.shard_id, self.replica_id, compact_to)
+                    self.compacted_to = compact_to
+                    self.events.log_compacted(EntryInfo(
+                        shard_id=self.shard_id, replica_id=self.replica_id,
+                        index=compact_to))
                 except Exception:
-                    pass
+                    _LOG.exception("log compaction failed")
         self.applied_since_snapshot = 0
         if req.key:
             self.pending_snapshot.done(
@@ -406,6 +580,9 @@ class Node:
         if self.peer.raft.log.inmem.snapshot is not None:
             # accepted: recover the user SM from the snapshot file
             self.sm.recover_from_snapshot(ss.filepath, ss)
+            self.events.snapshot_recovered(SnapshotInfo(
+                shard_id=self.shard_id, replica_id=self.replica_id,
+                from_=m.from_, index=ss.index, term=ss.term))
 
     def _apply_snapshot(self, ss: pb.Snapshot) -> None:
         self.logdb.save_snapshots([pb.Update(

@@ -22,13 +22,15 @@ from dataclasses import dataclass, field
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.client import Session
 from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.logdb.memdb import MemLogDB
 from dragonboat_tpu.node import Node, _SnapshotRequest
-from dragonboat_tpu.raftio import ILogDB
+from dragonboat_tpu.raftio import ILogDB, NodeInfo, SnapshotInfo
 from dragonboat_tpu.registry import Registry
 from dragonboat_tpu.request import (
     RequestDroppedError,
     RequestError,
+    RequestRejectedError,
     RequestState,
     RequestResultCode,
 )
@@ -74,6 +76,10 @@ class NodeHost:
             if nhconfig.logdb_factory else MemLogDB()
         )
         self.registry = Registry()
+        self.events = EventHub(
+            raft_listener=nhconfig.raft_event_listener,
+            system_listener=nhconfig.system_event_listener,
+        )
         self.mu = threading.RLock()
         self.nodes: dict[int, Node] = {}
         self.chunk_sink = ChunkSink(
@@ -91,6 +97,7 @@ class NodeHost:
             transport=self.transport,
             resolver=self.registry,
             unreachable_cb=self._on_unreachable,
+            events=self.events,
         )
         self._stopped = False
         self._work = threading.Event()
@@ -105,6 +112,7 @@ class NodeHost:
     # -- lifecycle ------------------------------------------------------
 
     def close(self) -> None:
+        self.events.node_host_shutting_down()
         with self.mu:
             self._stopped = True
             nodes = list(self.nodes.values())
@@ -114,8 +122,10 @@ class NodeHost:
             self._engine_thread.join(timeout=5)
         for n in nodes:
             n.destroy()
+            self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
         self.transport.close()
         self.logdb.close()
+        self.events.close()
 
     def start_replica(self, initial_members: dict[int, str], join: bool,
                       create_sm, cfg: Config) -> None:
@@ -141,7 +151,8 @@ class NodeHost:
             sm = StateMachine(cfg.shard_id, cfg.replica_id, user_sm,
                               cfg.ordered_config_change)
             snapshot_dir = f"/tmp/dragonboat_tpu/{self.id}/snapshots"
-            node = Node(cfg, self.logdb, sm, self._send_message, snapshot_dir)
+            node = Node(cfg, self.logdb, sm, self._send_message, snapshot_dir,
+                        events=self.events)
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
@@ -154,6 +165,7 @@ class NodeHost:
             for rid, addr in {**m.addresses, **m.non_votings, **m.witnesses}.items():
                 self.registry.add(cfg.shard_id, rid, addr)
             self.nodes[cfg.shard_id] = node
+        self.events.node_ready(NodeInfo(cfg.shard_id, cfg.replica_id))
         self._work.set()
 
     def stop_replica(self, shard_id: int) -> None:
@@ -162,6 +174,7 @@ class NodeHost:
         if node is None:
             raise ShardNotFoundError(f"shard {shard_id} not found")
         node.destroy()
+        self.events.node_unloaded(NodeInfo(shard_id, node.replica_id))
 
     stop_shard = stop_replica
 
@@ -238,6 +251,9 @@ class NodeHost:
         (chunk.go:106 → nodehost.go:2072 handoff).  The sender address rides
         chunk 0 so a joining replica can respond before any membership
         entry applies locally."""
+        self.events.snapshot_received(SnapshotInfo(
+            shard_id=m.shard_id, replica_id=m.to, from_=m.from_,
+            index=m.snapshot.index, term=m.snapshot.term))
         self._handle_message_batch(pb.MessageBatch(
             requests=(m,), deployment_id=self.config.deployment_id,
             source_address=source_address))
@@ -255,6 +271,14 @@ class NodeHost:
             self.registry.add(shard_id, cc.replica_id, cc.address)
         elif cc.type == pb.ConfigChangeType.REMOVE_NODE:
             self.registry.remove(shard_id, cc.replica_id)
+        with self.mu:
+            node = self.nodes.get(shard_id)
+        if node is not None:
+            self.events.membership_changed(
+                NodeInfo(shard_id, node.replica_id))
+            if (cc.type == pb.ConfigChangeType.REMOVE_NODE
+                    and cc.replica_id == node.replica_id):
+                self.events.node_deleted(NodeInfo(shard_id, node.replica_id))
 
     # -- helpers ---------------------------------------------------------
 
@@ -420,10 +444,18 @@ class NodeHost:
 
     def sync_request_compaction(self, shard_id: int,
                                 timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+        """SyncRequestCompaction: LogDB compaction up to the snapshotter's
+        compacted-to index, processed on the engine thread
+        (nodehost.go RequestCompaction → node.go:972)."""
         node = self._node(shard_id)
-        applied = node.sm.get_last_applied()
-        if applied > 0:
-            self.logdb.remove_entries_to(shard_id, node.replica_id, applied)
+        rs = node.request_compaction(self._ticks(timeout_s))
+        self._work.set()
+        r = rs.wait(timeout_s)
+        if r.code == RequestResultCode.REJECTED:
+            raise RequestRejectedError(
+                "nothing to compact (no snapshot taken yet)")
+        if r.code != RequestResultCode.COMPLETED:
+            raise RequestError(f"compaction failed: {r.code.name}")
 
     def sync_remove_data(self, shard_id: int, replica_id: int,
                          timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
@@ -438,18 +470,19 @@ class NodeHost:
     def query_raft_log(self, shard_id: int, first: int, last: int,
                        max_size: int = 0,
                        timeout_s: float = DEFAULT_TIMEOUT_S):
+        """QueryRaftLog (nodehost.go:781): the request rides the engine's
+        step loop and the result comes back on the Update path
+        (node.go:1238 handleLogQuery → node.go:319 processLogQuery)."""
         node = self._node(shard_id)
-        assert node.peer is not None
-        node.peer.query_raft_log(first, last, max_size)
+        rs = node.query_raft_log(first, last, max_size,
+                                 self._ticks(timeout_s))
         self._work.set()
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            r = node.peer.raft.log_query_result
-            if r is not None:
-                node.peer.raft.log_query_result = None
-                return r
-            time.sleep(0.005)
-        raise RequestError("log query timed out")
+        r = rs.wait(timeout_s)
+        if r.code == RequestResultCode.COMPLETED:
+            return rs.log_query_result
+        if r.code == RequestResultCode.REJECTED:
+            raise RequestError("log query out of range")
+        raise RequestError(f"log query failed: {r.code.name}")
 
     # -- info ------------------------------------------------------------
 
@@ -475,3 +508,8 @@ class NodeHost:
 
     def has_node_info(self, shard_id: int, replica_id: int) -> bool:
         return self.logdb.get_bootstrap_info(shard_id, replica_id) is not None
+
+    def metrics(self) -> dict[str, int]:
+        """Counter snapshot (the reference's Prometheus surface); the
+        transport hub shares the same registry under ``transport.*``."""
+        return self.events.metrics.snapshot()

@@ -14,7 +14,8 @@ from collections import deque
 from typing import Callable
 
 from dragonboat_tpu import raftpb as pb
-from dragonboat_tpu.raftio import INodeRegistry, ITransport
+from dragonboat_tpu.events import EventHub
+from dragonboat_tpu.raftio import INodeRegistry, ITransport, SnapshotInfo
 
 SEND_QUEUE_LEN = 1024 * 2
 BREAKER_RESET_SECONDS = 1.0
@@ -50,6 +51,7 @@ class TransportHub:
         resolver: INodeRegistry,
         unreachable_cb: Callable[[pb.Message], None],
         sync: bool = True,
+        events=None,
     ) -> None:
         self.source_address = source_address
         self.deployment_id = deployment_id
@@ -57,10 +59,30 @@ class TransportHub:
         self.resolver = resolver
         self.unreachable_cb = unreachable_cb
         self.sync = sync
+        self.events = events if events is not None else EventHub()
         self.mu = threading.Lock()
         self.queues: dict[str, deque[pb.Message]] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
-        self.metrics = {"sent": 0, "send_failed": 0, "dropped": 0}
+        self.connected: set[tuple[str, bool]] = set()
+        # counters live in the shared process-wide registry (events.Metrics)
+        self.metrics = self.events.metrics
+
+    def _note_connection(self, addr: str, ok: bool, snapshot: bool) -> None:
+        """Edge-triggered ConnectionEstablished/Failed events, keyed per
+        (addr, snapshot) connection class
+        (transport.go SendMessageBatch → sysEvents, event.go:54-90)."""
+        key = (addr, snapshot)
+        with self.mu:
+            if ok:
+                fire = key not in self.connected
+                self.connected.add(key)
+            else:
+                fire = True
+                self.connected.discard(key)
+        if fire and ok:
+            self.events.connection_established(addr, snapshot)
+        elif not ok:
+            self.events.connection_failed(addr, snapshot)
 
     def breaker(self, addr: str) -> CircuitBreaker:
         with self.mu:
@@ -79,11 +101,11 @@ class TransportHub:
         try:
             addr, _key = self.resolver.resolve(m.shard_id, m.to)
         except KeyError:
-            self.metrics["dropped"] += 1
+            self.metrics.inc("transport.dropped")
             return False
         b = self.breaker(addr)
         if not b.ready():
-            self.metrics["dropped"] += 1
+            self.metrics.inc("transport.dropped")
             self._notify_unreachable(m)
             return False
         with self.mu:
@@ -112,10 +134,12 @@ class TransportHub:
                 conn = self.transport.get_connection(a)
                 conn.send_message_batch(batch)
                 b.succeed()
-                self.metrics["sent"] += len(msgs)
+                self.metrics.inc("transport.sent", len(msgs))
+                self._note_connection(a, True, False)
             except Exception:
                 b.fail()
-                self.metrics["send_failed"] += len(msgs)
+                self.metrics.inc("transport.send_failed", len(msgs))
+                self._note_connection(a, False, False)
                 for m in msgs:
                     self._notify_unreachable(m)
 
@@ -146,16 +170,23 @@ class TransportHub:
         if not b.ready():
             self._notify_snapshot_failed(m)
             return False
+        info = SnapshotInfo(shard_id=m.shard_id, replica_id=m.to,
+                            from_=m.from_, index=m.snapshot.index,
+                            term=m.snapshot.term)
+        self.events.send_snapshot_started(info)
         try:
             conn = self.transport.get_snapshot_connection(addr)
             for c in chunks:
                 conn.send_chunk(c)
             b.succeed()
-            self.metrics["snapshots_sent"] = (
-                self.metrics.get("snapshots_sent", 0) + 1)
+            self.metrics.inc("transport.snapshots_sent")
+            self._note_connection(addr, True, True)
+            self.events.send_snapshot_completed(info)
             return True
         except Exception:
             b.fail()
+            self._note_connection(addr, False, True)
+            self.events.send_snapshot_aborted(info)
             self._notify_unreachable(m)
             self._notify_snapshot_failed(m)
             return False

@@ -18,6 +18,19 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# hermetic CPU: drop accelerator backend factories registered by the ambient
+# environment (the axon TPU plugin initializes its PJRT client on ANY
+# backends() call regardless of JAX_PLATFORMS — if the TPU tunnel is wedged,
+# that init blocks forever and would hang the whole suite)
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _plat in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_plat, None)
+
+# the ambient JAX_PLATFORMS=axon was latched when the sitecustomize imported
+# jax at interpreter start — os.environ edits above are too late; override
+# through the config API
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
 # persistent compile cache: the batched step kernel takes ~10-30s to compile;
 # cache it across pytest runs
