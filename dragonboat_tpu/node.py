@@ -152,8 +152,18 @@ class Node:
             if not rs.state.is_empty():
                 p.raft.load_state(rs.state)
             self.peer = p
-            # replay committed-but-unapplied entries through the RSM
+            # recover the user SM from the latest snapshot, then the step
+            # loop replays the committed tail (node.go:666 replayLog).
+            # A missing snapshot file is FATAL: the log below ss.index was
+            # compacted away, so skipping recovery would silently restart
+            # the user SM empty while claiming applied==ss.index
             if ss is not None:
+                if not ss.filepath or not os.path.exists(ss.filepath):
+                    raise RuntimeError(
+                        f"shard {self.shard_id} replica {self.replica_id}: "
+                        f"snapshot file {ss.filepath!r} (index {ss.index}) "
+                        f"is missing — cannot recover")
+                self.sm.recover_from_snapshot(ss.filepath, ss)
                 self.sm.members.set(ss.membership)
                 self.sm.last_applied = max(self.sm.last_applied, ss.index)
                 self.sm.last_applied_term = ss.term

@@ -24,6 +24,8 @@ from dragonboat_tpu.client import Session
 from dragonboat_tpu.config import Config, NodeHostConfig
 from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.logdb.memdb import MemLogDB
+from dragonboat_tpu.logdb.tan import TanLogDB
+from dragonboat_tpu.server.env import Env
 from dragonboat_tpu.node import Node, _SnapshotRequest
 from dragonboat_tpu.raftio import ILogDB, NodeInfo, SnapshotInfo
 from dragonboat_tpu.registry import Registry
@@ -70,11 +72,34 @@ class NodeHost:
                  auto_run: bool = True) -> None:
         nhconfig.validate()
         self.config = nhconfig
-        self.id = f"nhid-{uuid.uuid4()}"
-        self.logdb: ILogDB = logdb if logdb is not None else (
-            nhconfig.logdb_factory.create()  # type: ignore[union-attr]
-            if nhconfig.logdb_factory else MemLogDB()
-        )
+        # durable mode: with a NodeHostDir, the data dir is locked, the
+        # flag file validated, identity persisted, and the tan log engine
+        # is the default LogDB (nodehost.go NewNodeHost → server.NewEnv →
+        # CreateNodeHostDir / LockNodeHostDir / CheckNodeHostDir)
+        self.env: Env | None = None
+        if nhconfig.node_host_dir:
+            # NodeHostDir always drives env services (lock, flag file,
+            # identity, snapshot placement) — a custom LogDB only swaps
+            # the engine, as in the reference (config.LogDBFactory)
+            self.env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
+                           nhconfig.deployment_id)
+            self.env.lock()
+            custom = logdb is not None or nhconfig.logdb_factory is not None
+            if logdb is not None:
+                self.logdb: ILogDB = logdb
+            elif nhconfig.logdb_factory is not None:
+                self.logdb = nhconfig.logdb_factory.create()  # type: ignore[union-attr]
+            else:
+                self.logdb = TanLogDB(self.env.logdb_dir)
+            self.env.check_node_host_dir(
+                self.logdb.name() if custom else "tan")
+            self.id = self.env.node_host_id()
+        else:
+            self.id = f"nhid-{uuid.uuid4()}"
+            self.logdb = logdb if logdb is not None else (
+                nhconfig.logdb_factory.create()  # type: ignore[union-attr]
+                if nhconfig.logdb_factory else MemLogDB()
+            )
         self.registry = Registry()
         self.events = EventHub(
             raft_listener=nhconfig.raft_event_listener,
@@ -126,6 +151,8 @@ class NodeHost:
         self.transport.close()
         self.logdb.close()
         self.events.close()
+        if self.env is not None:
+            self.env.close()
 
     def start_replica(self, initial_members: dict[int, str], join: bool,
                       create_sm, cfg: Config) -> None:
@@ -150,7 +177,11 @@ class NodeHost:
             user_sm = create_sm(cfg.shard_id, cfg.replica_id)
             sm = StateMachine(cfg.shard_id, cfg.replica_id, user_sm,
                               cfg.ordered_config_change)
-            snapshot_dir = f"/tmp/dragonboat_tpu/{self.id}/snapshots"
+            snapshot_dir = (
+                self.env.snapshot_dir(cfg.shard_id, cfg.replica_id)
+                if self.env is not None
+                else f"/tmp/dragonboat_tpu/{self.id}/snapshots"
+            )
             node = Node(cfg, self.logdb, sm, self._send_message, snapshot_dir,
                         events=self.events)
             node.membership_changed_cb = (

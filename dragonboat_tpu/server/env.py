@@ -1,0 +1,199 @@
+"""Env — NodeHost data-directory management.
+
+Parity with ``internal/server/environment.go``:
+
+- dir hierarchy  ``<node_host_dir>/<deployment_id %020d>/<host-part>/``
+  (getDeploymentIDSubDirName :376; the host-part keeps multiple in-process
+  NodeHosts on one box separate, like the reference's per-address dirs);
+- exclusive LOCK file via flock so two NodeHosts can never share a data
+  dir (:290 LockNodeHostDir, ErrLockDirectory);
+- ``dragonboat.ds`` flag file pinning raft address, hostname, deployment
+  id, LogDB type, binary version and the hard-settings hash — any
+  mismatch refuses the reopen (:390 check, ErrNotOwner /
+  ErrHostnameChanged / ErrDeploymentIDChanged / ErrLogDBType /
+  ErrIncompatibleData);
+- persistent NodeHost identity (NODEHOST.ID; :206-270);
+- per-shard snapshot dirs with a REMOVED tombstone flag (:127-204, :304).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import socket
+import uuid
+
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.server.settings import hard
+
+_LOG = get_logger("server")
+
+LOCK_FILENAME = "LOCK"
+FLAG_FILENAME = "dragonboat.ds"
+NHID_FILENAME = "NODEHOST.ID"
+REMOVED_FLAG = "REMOVED.dbtpu"
+BIN_VER = 1
+
+
+class EnvError(Exception):
+    pass
+
+
+class DirLockedError(EnvError):
+    """Another NodeHost holds the data dir (ErrLockDirectory)."""
+
+
+class NotOwnerError(EnvError):
+    """The data dir belongs to a different raft address (ErrNotOwner)."""
+
+
+class IncompatibleDataError(EnvError):
+    """Hostname / deployment id / LogDB type / bin ver / hard settings
+    changed since the dir was created."""
+
+
+def _sanitize(addr: str) -> str:
+    return "".join(c if (c.isalnum() or c in "._-") else "_" for c in addr)
+
+
+class Env:
+    """One NodeHost's view of its data directories."""
+
+    def __init__(self, node_host_dir: str, raft_address: str,
+                 deployment_id: int = 0) -> None:
+        self.raft_address = raft_address
+        self.deployment_id = deployment_id
+        self.hostname = socket.gethostname()
+        self.root = os.path.join(
+            os.path.abspath(node_host_dir),
+            f"{deployment_id:020d}",
+            _sanitize(raft_address),
+        )
+        os.makedirs(self.root, exist_ok=True)
+        self._lock_file = None
+        self._nhid: str | None = None
+
+    # -- dirs -------------------------------------------------------------
+
+    @property
+    def logdb_dir(self) -> str:
+        d = os.path.join(self.root, "logdb")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def snapshot_dir(self, shard_id: int, replica_id: int) -> str:
+        """GetSnapshotDir (:127): per-replica snapshot home."""
+        d = os.path.join(
+            self.root, "snapshot",
+            f"snapshot-{shard_id:016X}-{replica_id:016X}",
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def remove_snapshot_dir(self, shard_id: int, replica_id: int) -> None:
+        """RemoveSnapshotDir (:304): tombstone then best-effort delete."""
+        d = self.snapshot_dir(shard_id, replica_id)
+        with open(os.path.join(d, REMOVED_FLAG), "w") as f:
+            f.write("removed\n")
+            f.flush()
+            os.fsync(f.fileno())
+        for fn in os.listdir(d):
+            if fn != REMOVED_FLAG:
+                try:
+                    os.remove(os.path.join(d, fn))
+                except OSError:
+                    pass
+
+    def snapshot_dir_removed(self, shard_id: int, replica_id: int) -> bool:
+        return os.path.exists(os.path.join(
+            self.snapshot_dir(shard_id, replica_id), REMOVED_FLAG))
+
+    # -- locking ----------------------------------------------------------
+
+    def lock(self) -> None:
+        """LockNodeHostDir (:290): exclusive, non-blocking flock."""
+        if self._lock_file is not None:
+            return
+        fp = os.path.join(self.root, LOCK_FILENAME)
+        f = open(fp, "a+")
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            f.close()
+            raise DirLockedError(
+                f"failed to lock data directory {self.root}: another "
+                f"NodeHost is using it")
+        self._lock_file = f
+
+    def close(self) -> None:
+        if self._lock_file is not None:
+            fcntl.flock(self._lock_file.fileno(), fcntl.LOCK_UN)
+            self._lock_file.close()
+            self._lock_file = None
+
+    # -- flag file (dragonboat.ds) -----------------------------------------
+
+    def check_node_host_dir(self, logdb_type: str) -> None:
+        """check (:390): create or validate the data-status flag file."""
+        fp = os.path.join(self.root, FLAG_FILENAME)
+        status = {
+            "address": self.raft_address,
+            "hostname": self.hostname,
+            "deployment_id": self.deployment_id,
+            "logdb_type": logdb_type,
+            "bin_ver": BIN_VER,
+            "hard_hash": hard.hash(),
+        }
+        if not os.path.exists(fp):
+            tmp = fp + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(status, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fp)
+            return
+        with open(fp) as f:
+            saved = json.load(f)
+        if saved.get("address", "").strip().lower() != \
+                self.raft_address.strip().lower():
+            raise NotOwnerError(
+                f"data dir {self.root} belongs to raft address "
+                f"{saved.get('address')!r}, not {self.raft_address!r}")
+        if saved.get("hostname") and saved["hostname"] != self.hostname:
+            raise IncompatibleDataError(
+                f"hostname changed: {saved['hostname']} -> {self.hostname}")
+        if saved.get("deployment_id", 0) != self.deployment_id:
+            raise IncompatibleDataError(
+                f"deployment id changed: {saved.get('deployment_id')} -> "
+                f"{self.deployment_id}")
+        if saved.get("logdb_type") and saved["logdb_type"] != logdb_type:
+            raise IncompatibleDataError(
+                f"LogDB type changed: {saved['logdb_type']} -> {logdb_type}")
+        if saved.get("bin_ver") != BIN_VER:
+            raise IncompatibleDataError(
+                f"binary version changed: {saved.get('bin_ver')} -> {BIN_VER}")
+        if saved.get("hard_hash") != hard.hash():
+            raise IncompatibleDataError(
+                "hard settings changed since this deployment was created — "
+                "refusing to open (would corrupt data)")
+
+    # -- identity ----------------------------------------------------------
+
+    def node_host_id(self) -> str:
+        """Persistent NodeHost identity (:206 NodeHostID / :212 Prepare)."""
+        if self._nhid is not None:
+            return self._nhid
+        fp = os.path.join(self.root, NHID_FILENAME)
+        if os.path.exists(fp):
+            with open(fp) as f:
+                self._nhid = f.read().strip()
+        else:
+            self._nhid = f"nhid-{uuid.uuid4()}"
+            tmp = fp + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self._nhid + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fp)
+        return self._nhid

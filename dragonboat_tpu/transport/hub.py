@@ -63,7 +63,9 @@ class TransportHub:
         self.mu = threading.Lock()
         self.queues: dict[str, deque[pb.Message]] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
-        self.connected: set[tuple[str, bool]] = set()
+        # (addr, snapshot) -> last observed connection state; edge-triggered
+        # listener events fire only on state changes (and first observation)
+        self.connected: dict[tuple[str, bool], bool] = {}
         # counters live in the shared process-wide registry (events.Metrics)
         self.metrics = self.events.metrics
 
@@ -73,15 +75,14 @@ class TransportHub:
         (transport.go SendMessageBatch → sysEvents, event.go:54-90)."""
         key = (addr, snapshot)
         with self.mu:
-            if ok:
-                fire = key not in self.connected
-                self.connected.add(key)
-            else:
-                fire = True
-                self.connected.discard(key)
-        if fire and ok:
+            prev = self.connected.get(key)
+            self.connected[key] = ok
+            fire = ok != prev  # first observation (prev None) always fires
+        if not fire:
+            return
+        if ok:
             self.events.connection_established(addr, snapshot)
-        elif not ok:
+        else:
             self.events.connection_failed(addr, snapshot)
 
     def breaker(self, addr: str) -> CircuitBreaker:

@@ -28,8 +28,7 @@ def make_cluster(quiesce=False, snapshot_entries=0, rtt_ms=5, prefix="ops",
     for rid, addr in addrs.items():
         nh = NodeHost(NodeHostConfig(
             raft_address=addr, rtt_millisecond=rtt_ms,
-            node_host_dir="/tmp/x",
-            raft_event_listener=raft_listener,
+                        raft_event_listener=raft_listener,
             system_event_listener=system_listener,
         ))
         cfg = Config(shard_id=1, replica_id=rid, election_rtt=election_rtt,

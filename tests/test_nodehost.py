@@ -49,8 +49,7 @@ def make_cluster(shard_id=1, n=3, snapshot_entries=0, rtt_ms=5,
     addrs = {i: f"{addr_prefix}-{i}" for i in range(1, n + 1)}
     hosts = {}
     for rid, addr in addrs.items():
-        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=rtt_ms,
-                                     node_host_dir="/tmp/x"))
+        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=rtt_ms))
         cfg = Config(shard_id=shard_id, replica_id=rid, election_rtt=10,
                      heartbeat_rtt=1, snapshot_entries=snapshot_entries,
                      compaction_overhead=5)
@@ -145,7 +144,7 @@ def test_membership_add_and_remove(cluster):
     addr4 = list(cluster.values())[0].config.raft_address.rsplit("-", 1)[0] + "-4"
     nh.sync_request_add_replica(1, 4, addr4, m.config_change_id)
     nh4 = NodeHost(NodeHostConfig(raft_address=addr4, rtt_millisecond=5,
-                                  node_host_dir="/tmp/x"))
+                                  ))
     try:
         cfg = Config(shard_id=1, replica_id=4, election_rtt=10, heartbeat_rtt=1)
         nh4.start_replica({}, True, KVStateMachine, cfg)
@@ -209,7 +208,7 @@ def test_snapshot_and_restart():
         logdb = old.logdb
         old.close()
         nh2 = NodeHost(NodeHostConfig(raft_address=addrs[frid],
-                                      rtt_millisecond=5, node_host_dir="/tmp/x"),
+                                      rtt_millisecond=5),
                        logdb=logdb)
         hosts[frid] = nh2
         cfg = Config(shard_id=1, replica_id=frid, election_rtt=10,
