@@ -30,6 +30,9 @@ class Config:
     quiesce: bool = False
     wait_ready: bool = False
     disable_auto_compaction: bool = False
+    # TPU-native surface: run this shard as a lane of the host's batched
+    # device kernel instead of a host-Python Peer (engine/kernel_engine.py)
+    device_resident: bool = False
 
     def validate(self) -> None:
         if self.replica_id == 0:
@@ -70,6 +73,12 @@ class ExpertConfig:
     kernel_inbox_cap: int = 8
     kernel_msg_entries: int = 8
     kernel_proposal_cap: int = 8
+    kernel_num_peers: int = 5
+    kernel_readindex_cap: int = 4
+    kernel_apply_batch: int = 64
+    kernel_compaction_overhead: int = 64
+    # max device-resident shards per NodeHost (lanes of the batched state)
+    kernel_capacity: int = 1024
 
 
 @dataclass

@@ -156,8 +156,11 @@ class Node:
             # loop replays the committed tail (node.go:666 replayLog).
             # A missing snapshot file is FATAL: the log below ss.index was
             # compacted away, so skipping recovery would silently restart
-            # the user SM empty while claiming applied==ss.index
-            if ss is not None:
+            # the user SM empty while claiming applied==ss.index.
+            # A LIVE SM already applied past the snapshot (kernel-engine
+            # eviction rebuilds a Node around the running SM) — recovery
+            # would regress it, so it is skipped.
+            if ss is not None and self.sm.get_last_applied() < ss.index:
                 if not ss.filepath or not os.path.exists(ss.filepath):
                     raise RuntimeError(
                         f"shard {self.shard_id} replica {self.replica_id}: "
@@ -201,19 +204,25 @@ class Node:
         self.sm.close()
 
     # -- client entry points (called from NodeHost) ------------------------
+    #
+    # every ingress mutation goes through _post so an engine can redirect
+    # a node's intake atomically (kernel-engine eviction swaps the serving
+    # object mid-flight; see KernelNode._post)
+
+    def _post(self, mutate) -> None:
+        with self.mu:
+            mutate(self)
 
     def propose(self, session: Session, cmd: bytes,
                 timeout_ticks: int) -> RequestState:
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
-        with self.mu:
-            self.incoming_proposals.append(entry)
+        self._post(lambda n: n.incoming_proposals.append(entry))
         return rs
 
     def propose_session_op(self, session: Session,
                            timeout_ticks: int) -> RequestState:
         rs, entry = self.pending_proposals.propose(session, b"", timeout_ticks)
-        with self.mu:
-            self.incoming_proposals.append(entry)
+        self._post(lambda n: n.incoming_proposals.append(entry))
         return rs
 
     def read(self, timeout_ticks: int) -> RequestState:
@@ -227,16 +236,18 @@ class Node:
             key=key,
             cmd=pb.encode_config_change(cc),
         )
-        with self.mu:
-            self.config_change_entry = entry
+        self._post(lambda n: setattr(n, "config_change_entry", entry))
         return rs
 
     def request_leader_transfer(self, target: int,
                                 timeout_ticks: int) -> RequestState:
         rs, key = self.pending_transfer.request(timeout_ticks)
-        with self.mu:
-            self.transfer_target = target
-            self._transfer_awaiting = (target, key)
+
+        def mutate(n):
+            n.transfer_target = target
+            n._transfer_awaiting = (target, key)
+
+        self._post(mutate)
         return rs
 
     def query_raft_log(self, first: int, last: int, max_size: int,
@@ -245,16 +256,15 @@ class Node:
         handleLogQuery): the request rides the step loop; the result lands
         on the returned RequestState as ``log_query_result``."""
         rs, _key = self.pending_log_query.request(timeout_ticks)
-        with self.mu:
-            self.log_query_range = (first, last, max_size)
+        self._post(lambda n: setattr(n, "log_query_range",
+                                     (first, last, max_size)))
         return rs
 
     def request_compaction(self, timeout_ticks: int) -> RequestState:
         """RequestCompaction (node.go:972): LogDB-level compaction up to
         the snapshotter's compacted-to index, on the engine thread."""
         rs, key = self.pending_compaction.request(timeout_ticks)
-        with self.mu:
-            self.compaction_request_key = key
+        self._post(lambda n: setattr(n, "compaction_request_key", key))
         return rs
 
     def request_snapshot(self, req: _SnapshotRequest | None,
@@ -262,13 +272,11 @@ class Node:
         rs, key = self.pending_snapshot.request(timeout_ticks)
         r = req or _SnapshotRequest()
         r.key = key
-        with self.mu:
-            self.snapshot_request = r
+        self._post(lambda n: setattr(n, "snapshot_request", r))
         return rs
 
     def handle_message(self, m: pb.Message) -> None:
-        with self.mu:
-            self.incoming_msgs.append(m)
+        self._post(lambda n: n.incoming_msgs.append(m))
 
     def tick(self) -> None:
         with self.mu:

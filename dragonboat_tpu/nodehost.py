@@ -128,6 +128,9 @@ class NodeHost:
         self._work = threading.Event()
         self._engine_thread: threading.Thread | None = None
         self._tick_interval = nhconfig.rtt_millisecond / 1000.0
+        # the batched device engine, created on the first device-resident
+        # shard (engine/kernel_engine.py)
+        self.kernel_engine = None
         if auto_run:
             self._engine_thread = threading.Thread(
                 target=self._engine_main, name=f"engine-{self.id[:12]}",
@@ -182,8 +185,14 @@ class NodeHost:
                 if self.env is not None
                 else f"/tmp/dragonboat_tpu/{self.id}/snapshots"
             )
-            node = Node(cfg, self.logdb, sm, self._send_message, snapshot_dir,
-                        events=self.events)
+            device = cfg.device_resident and not cfg.is_witness
+            node_cls = Node
+            if device:
+                from dragonboat_tpu.engine.kernel_engine import KernelNode
+
+                node_cls = KernelNode
+            node = node_cls(cfg, self.logdb, sm, self._send_message,
+                            snapshot_dir, events=self.events)
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
@@ -196,6 +205,10 @@ class NodeHost:
             for rid, addr in {**m.addresses, **m.non_votings, **m.witnesses}.items():
                 self.registry.add(cfg.shard_id, rid, addr)
             self.nodes[cfg.shard_id] = node
+        if device:
+            # outside self.mu: the engine lock orders engine.mu -> host.mu
+            # on the eviction path, so injection must not hold host.mu
+            self._inject_kernel_shard(node, members)
         self.events.node_ready(NodeInfo(cfg.shard_id, cfg.replica_id))
         self._work.set()
 
@@ -204,8 +217,130 @@ class NodeHost:
             node = self.nodes.pop(shard_id, None)
         if node is None:
             raise ShardNotFoundError(f"shard {shard_id} not found")
+        if self.kernel_engine is not None:
+            self.kernel_engine.remove_shard(shard_id)
         node.destroy()
         self.events.node_unloaded(NodeInfo(shard_id, node.replica_id))
+
+    # -- kernel engine glue ----------------------------------------------
+
+    def _inject_kernel_shard(self, node, members: dict[int, str]) -> None:
+        """Move a freshly-bootstrapped shard onto the device kernel: the
+        pycore Peer built by node.start() provides the persisted state;
+        its in-memory tail (bootstrap config changes) rides along."""
+        from dragonboat_tpu.core import params as KP
+        from dragonboat_tpu.engine.kernel_engine import (
+            KernelEngine,
+            _LaneInit,
+        )
+
+        if self.kernel_engine is None:
+            ex = self.config.expert
+            kp = KP.KernelParams(
+                num_peers=ex.kernel_num_peers,
+                log_cap=ex.kernel_log_cap,
+                inbox_cap=ex.kernel_inbox_cap,
+                msg_entries=ex.kernel_msg_entries,
+                proposal_cap=ex.kernel_proposal_cap,
+                readindex_cap=ex.kernel_readindex_cap,
+                apply_batch=ex.kernel_apply_batch,
+                compaction_overhead=ex.kernel_compaction_overhead,
+            )
+            self.kernel_engine = KernelEngine(
+                kp, ex.kernel_capacity, self._send_message,
+                events=self.events)
+            self.kernel_engine.on_evict = self._on_kernel_evict
+        raft = node.peer.raft
+        log = raft.log
+        first, last = log.first_index(), log.last_index()
+        entries = log.get_entries(first, last + 1) if last >= first else []
+        ss = self.logdb.get_snapshot(node.shard_id, node.replica_id)
+        m = node.sm.get_membership()
+        peers = ([(rid, KP.K_VOTER) for rid in sorted(m.addresses)]
+                 + [(rid, KP.K_NON_VOTING) for rid in sorted(m.non_votings)]
+                 + [(rid, KP.K_WITNESS) for rid in sorted(m.witnesses)])
+        if not peers:
+            peers = [(rid, KP.K_VOTER) for rid in sorted(members)]
+        init = _LaneInit(
+            term=raft.term, vote=raft.vote, committed=log.committed,
+            applied=node.sm.get_last_applied(),
+            snap_index=ss.index if ss is not None else 0,
+            snap_term=ss.term if ss is not None else 0,
+            entries=entries, peers=peers,
+        )
+        # the lane is injected with stable == last, so everything the Peer
+        # held in memory (bootstrap config changes, unsaved tail) must be
+        # durable BEFORE the kernel takes over (idempotent on restart)
+        self.logdb.save_raft_state([pb.Update(
+            shard_id=node.shard_id, replica_id=node.replica_id,
+            state=pb.State(term=raft.term, vote=raft.vote,
+                           commit=log.committed),
+            entries_to_save=tuple(entries),
+        )], worker_id=0)
+        try:
+            if len(entries) > self.kernel_engine.kp.log_cap:
+                raise RequestError(
+                    "log tail larger than the kernel ring")
+            if len(peers) > self.kernel_engine.kp.num_peers:
+                raise RequestError(
+                    "membership larger than the kernel peer book")
+            node.peer = None  # the lane owns the protocol state now
+            self.kernel_engine.add_shard(node, init)
+        except Exception as e:
+            # fall back to the host engine rather than leaving a dead
+            # shard registered (the state above is already durable)
+            self._on_kernel_evict(node, [])
+            import logging
+
+            logging.getLogger("dragonboat_tpu.nodehost").warning(
+                "shard %d: not device-resident (%s); running host-side",
+                node.shard_id, e)
+
+    def _on_kernel_evict(self, knode, carry: list[pb.Message]) -> None:
+        """needs_host slow path: rebuild the shard as a host-resident
+        pycore Node from the (already durable) LogDB state and keep every
+        in-flight request future alive."""
+        cfg = knode.cfg
+        with self.mu:
+            if self._stopped or self.nodes.get(cfg.shard_id) is not knode:
+                return  # stopped/replaced concurrently — do not resurrect
+        node = Node(cfg, self.logdb, knode.sm, self._send_message,
+                    knode.snapshot_dir, events=self.events)
+        node.membership_changed_cb = (
+            lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc))
+        # transplant the books so callers' futures survive the move
+        for attr in ("pending_proposals", "pending_reads",
+                     "pending_config_change", "pending_snapshot",
+                     "pending_transfer", "pending_log_query",
+                     "pending_compaction"):
+            setattr(node, attr, getattr(knode, attr))
+        node.start({}, initial=False, new_node=False)
+        for m in carry:
+            node.handle_message(m)
+        # atomic handoff: _moved is set under knode.mu, THEN the queues
+        # and scalar requests are drained under the same lock — any later
+        # ingress (Node._post) sees _moved and lands on the successor
+        with knode.mu:
+            knode._moved = node
+            node.incoming_msgs.extend(knode.incoming_msgs)
+            knode.incoming_msgs = []
+            node.incoming_proposals.extend(knode.incoming_proposals)
+            knode.incoming_proposals = []
+            for f in ("config_change_entry", "transfer_target",
+                      "snapshot_request", "log_query_range",
+                      "compaction_request_key"):
+                v = getattr(knode, f)
+                if v is not None and getattr(node, f) is None:
+                    setattr(node, f, v)
+                setattr(knode, f, None)
+            node._transfer_awaiting = knode._transfer_awaiting
+            node._last_leader = (knode._leader_cache,
+                                 knode._leader_term_cache)
+        with self.mu:
+            if self.nodes.get(cfg.shard_id) is knode:
+                self.nodes[cfg.shard_id] = node
+            # else: stop_replica raced us and already destroyed the books
+        self._work.set()
 
     stop_shard = stop_replica
 
@@ -237,6 +372,15 @@ class NodeHost:
             for n in nodes:
                 try:
                     if n.step():
+                        progressed = True
+                        steps += 1
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            if self.kernel_engine is not None:
+                try:
+                    if self.kernel_engine.step_all():
                         progressed = True
                         steps += 1
                 except Exception:

@@ -1,0 +1,45 @@
+"""Scale test: 1k device-resident shards on one kernel state.
+
+Kept in its own module (sorting last) because the jitted [1024]-lane step
+keeps the CPU busy; running it mid-suite starves the real-time E2E tests
+that follow.
+"""
+
+import time
+
+from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+
+from test_nodehost import KVStateMachine
+
+
+def test_kernel_1k_shards_one_process():
+
+    """1024 single-replica shards on one host's kernel state: every shard
+    elects and serves writes; one jitted step advances all of them."""
+    shards = tuple(range(1, 1025))
+    nh = NodeHost(NodeHostConfig(
+        raft_address="k1k-1", rtt_millisecond=5,
+        expert=ExpertConfig(kernel_log_cap=64, kernel_capacity=1024,
+                            kernel_apply_batch=8,
+                            kernel_compaction_overhead=8)))
+    try:
+        addrs = {1: "k1k-1"}
+        for sid in shards:
+            nh.start_replica(addrs, False, KVStateMachine, Config(
+                shard_id=sid, replica_id=1, election_rtt=10, heartbeat_rtt=2,
+                device_resident=True))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            leaders = sum(nh.get_leader_id(s)[1] for s in shards)
+            if leaders == len(shards):
+                break
+            time.sleep(0.2)
+        assert leaders == len(shards), f"only {leaders}/1024 shards elected"
+        # writes on a sample of shards
+        for sid in (1, 7, 512, 1024):
+            sess = nh.get_noop_session(sid)
+            nh.sync_propose(sess, b"big=cluster", timeout_s=20)
+            assert nh.sync_read(sid, "big", timeout_s=20) == "cluster"
+    finally:
+        nh.close()
