@@ -102,15 +102,28 @@ def run_bench() -> None:
     lead = np.asarray(state.role) == KP.LEADER
     assert lead.reshape(-1, replicas).any(axis=1).all()
 
-    # warmup (compile the propose-loop variant)
+    # warmup: compile exactly the loop variants the timed region will run
+    # (iters is a static jit arg — chunk and remainder sizes each compile)
+    chunk = max(1, int(os.environ.get("BENCH_CHUNK", "25")))
     t_compile = time.time()
-    state, box = run_steps(kp, replicas, 5, True, True, state, box)
+    state, box = run_steps(kp, replicas, min(chunk, steps), True, True,
+                           state, box)
+    if steps % chunk:
+        state, box = run_steps(kp, replicas, steps % chunk, True, True,
+                               state, box)
     state.term.block_until_ready()
     compile_s = time.time() - t_compile
 
     c0 = np.asarray(state.committed)[lead].astype(np.int64).sum()
+    # chunk the device loop: one fori_loop launch of N*step_ms can trip
+    # the TPU watchdog ("TPU device error") when a run exceeds ~60 s —
+    # bounded launches keep each dispatch well under it
     t0 = time.time()
-    state, box = run_steps(kp, replicas, steps, True, True, state, box)
+    done = 0
+    while done < steps:
+        n = min(chunk, steps - done)
+        state, box = run_steps(kp, replicas, n, True, True, state, box)
+        done += n
     state.committed.block_until_ready()
     dt = time.time() - t0
     c1 = np.asarray(state.committed)[lead].astype(np.int64).sum()

@@ -30,6 +30,9 @@ class Config:
     quiesce: bool = False
     wait_ready: bool = False
     disable_auto_compaction: bool = False
+    # compression envelope for snapshot files (config.CompressionType
+    # Snappy analog; V3 per-block zlib in rsm/snapshotio.py)
+    snapshot_compression: bool = False
     # TPU-native surface: run this shard as a lane of the host's batched
     # device kernel instead of a host-Python Peer (engine/kernel_engine.py)
     device_resident: bool = False
@@ -125,8 +128,12 @@ class NodeHostConfig:
             raise ConfigError("invalid RTTMillisecond")
         if not self.raft_address:
             raise ConfigError("RaftAddress not set")
-        if self.address_by_node_host_id and self.gossip.is_empty():
-            raise ConfigError("gossip must be configured for AddressByNodeHostID")
+        if self.address_by_node_host_id:
+            if self.gossip.is_empty():
+                raise ConfigError(
+                    "gossip must be configured for AddressByNodeHostID")
+            if not self.gossip.bind_address:
+                raise ConfigError("gossip.bind_address not set")
 
     def prepare(self) -> None:
         if not self.node_host_dir:

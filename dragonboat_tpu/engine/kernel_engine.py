@@ -151,7 +151,11 @@ class KernelNode(Node):
             index=index, term=term, membership=membership,
             shard_id=self.shard_id, type=self.sm.sm_type,
         )
-        if not req.exported:
+        if req.exported:
+            from dragonboat_tpu.tools import write_export_metadata
+
+            write_export_metadata(path, ss)
+        else:
             self.logdb.save_snapshots([pb.Update(
                 shard_id=self.shard_id, replica_id=self.replica_id,
                 snapshot=ss)])

@@ -1,0 +1,197 @@
+"""Gossip registry — dynamic NodeHostID-based addressing.
+
+Parity with the reference's ``internal/registry/gossip.go``: when
+``NodeHostConfig.address_by_node_host_id`` is set, raft targets are
+persistent NodeHostIDs instead of raft addresses, and each host's
+current raft address is disseminated by an anti-entropy gossip protocol
+(the reference rides hashicorp/memberlist; this is a self-contained UDP
+implementation of the same behavior: per-member versioned meta records
+{nhid → raft_address}, periodic push to seeds + random peers, merge by
+version, dead-member expiry).
+
+``GossipRegistry`` wraps the static registry: (shard, replica) resolves
+to a target string as usual; a target that is a NodeHostID is then
+translated through the gossip view (gossip.go:157 Resolve →
+metaStore.get).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.raftio import INodeRegistry
+from dragonboat_tpu.registry import Registry
+
+_LOG = get_logger("gossip")
+
+GOSSIP_INTERVAL_S = 0.15
+FANOUT = 3
+EXPIRY_S = 30.0
+
+
+class _Meta:
+    __slots__ = ("raft_address", "version", "seen_at")
+
+    def __init__(self, raft_address: str, version: int) -> None:
+        self.raft_address = raft_address
+        self.version = version
+        self.seen_at = time.monotonic()
+
+
+class GossipManager:
+    """UDP anti-entropy: each round, push the full view to up to FANOUT
+    known members (+ the seeds until they answer)."""
+
+    def __init__(self, nhid: str, raft_address: str, bind_address: str,
+                 advertise_address: str = "", seeds: list[str] | None = None,
+                 interval_s: float = GOSSIP_INTERVAL_S) -> None:
+        self.nhid = nhid
+        self.raft_address = raft_address
+        self.interval_s = interval_s
+        host, port = _parse(bind_address)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.sock.settimeout(0.05)
+        bound = self.sock.getsockname()
+        if not advertise_address and bound[0] in ("0.0.0.0", "", "::"):
+            self.sock.close()
+            raise ValueError(
+                "gossip: a wildcard bind_address requires an explicit "
+                "advertise_address (peers would gossip to themselves)")
+        self.advertise = advertise_address or f"{bound[0]}:{bound[1]}"
+        self.seeds = [s for s in (seeds or []) if s != self.advertise]
+        self.mu = threading.Lock()
+        # nhid -> meta; members: gossip address -> last seen
+        self.view: dict[str, _Meta] = {
+            nhid: _Meta(raft_address, int(time.time() * 1000))}
+        self.members: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="gossip",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- protocol ---------------------------------------------------------
+
+    def _payload(self) -> bytes:
+        with self.mu:
+            view = {n: [m.raft_address, m.version]
+                    for n, m in self.view.items()}
+        return json.dumps({
+            "from": self.advertise,
+            "view": view,
+        }).encode()
+
+    def _run(self) -> None:
+        last_push = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_push >= self.interval_s:
+                last_push = now
+                self._push()
+            try:
+                data, addr = self.sock.recvfrom(65536)
+            except (socket.timeout, OSError):
+                continue
+            try:
+                msg = json.loads(data.decode())
+            except ValueError:
+                continue
+            self._merge(msg)
+
+    def _push(self) -> None:
+        payload = self._payload()
+        with self.mu:
+            known = list(self.members)
+        targets = set(self.seeds)
+        if known:
+            targets.update(random.sample(known, min(FANOUT, len(known))))
+        for t in targets:
+            try:
+                self.sock.sendto(payload, _parse(t))
+            except OSError:
+                pass
+
+    def _merge(self, msg: dict) -> None:
+        src = msg.get("from")
+        now = time.monotonic()
+        with self.mu:
+            if src and src != self.advertise:
+                self.members[src] = now
+            for nhid, rec in (msg.get("view") or {}).items():
+                try:
+                    addr, version = rec[0], int(rec[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                cur = self.view.get(nhid)
+                if cur is None or version > cur.version:
+                    self.view[nhid] = _Meta(addr, version)
+                elif cur is not None:
+                    cur.seen_at = now
+            # expire members we have not heard from
+            for m in [m for m, ts in self.members.items()
+                      if now - ts > EXPIRY_S]:
+                del self.members[m]
+
+    # -- queries ----------------------------------------------------------
+
+    def lookup(self, nhid: str) -> str | None:
+        with self.mu:
+            m = self.view.get(nhid)
+            return m.raft_address if m is not None else None
+
+    def num_members(self) -> int:
+        with self.mu:
+            return len(self.members) + 1
+
+    def set_raft_address(self, raft_address: str) -> None:
+        """Re-advertise after an address change (the reason this whole
+        subsystem exists: stable identity over movable addresses)."""
+        with self.mu:
+            self.raft_address = raft_address
+            self.view[self.nhid] = _Meta(raft_address,
+                                         int(time.time() * 1000))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.sock.close()
+
+
+def _parse(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class GossipRegistry(INodeRegistry):
+    """INodeRegistry whose targets may be NodeHostIDs (gossip.go:99)."""
+
+    def __init__(self, manager: GossipManager) -> None:
+        self.manager = manager
+        self.static = Registry()
+
+    def add(self, shard_id: int, replica_id: int, target: str) -> None:
+        self.static.add(shard_id, replica_id, target)
+
+    def remove(self, shard_id: int, replica_id: int) -> None:
+        self.static.remove(shard_id, replica_id)
+
+    def remove_shard(self, shard_id: int) -> None:
+        self.static.remove_shard(shard_id)
+
+    def resolve(self, shard_id: int, replica_id: int) -> tuple[str, str]:
+        target, key = self.static.resolve(shard_id, replica_id)
+        if target.startswith("nhid-"):
+            addr = self.manager.lookup(target)
+            if addr is None:
+                raise KeyError(
+                    f"NodeHostID {target} not (yet) known to gossip")
+            return addr, key
+        return target, key
+
+    def close(self) -> None:
+        self.manager.close()

@@ -124,6 +124,7 @@ class Node:
             is_witness=self.cfg.is_witness,
         )
         ss = self.logdb.get_snapshot(self.shard_id, self.replica_id)
+        self._gc_snapshot_dir(ss)
         if ss is not None:
             self.log_reader.apply_snapshot(ss)
         rs = self.logdb.read_raft_state(
@@ -530,6 +531,33 @@ class Node:
 
     # -- snapshots -------------------------------------------------------
 
+    def _gc_snapshot_dir(self, live: pb.Snapshot | None) -> None:
+        """Startup orphan GC (snapshotter.go:200 processOrphans): remove
+        half-written images (crash mid-save left a .generating temp) and
+        committed-but-superseded snapshot files other than the recorded
+        live one."""
+        if not os.path.isdir(self.snapshot_dir):
+            return
+        live_name = (os.path.basename(live.filepath)
+                     if live is not None and live.filepath else None)
+        prefix = f"snapshot-{self.shard_id:016X}-{self.replica_id:016X}-"
+        for fn in os.listdir(self.snapshot_dir):
+            full = os.path.join(self.snapshot_dir, fn)
+            if not fn.startswith(prefix):
+                continue  # another shard's files (shared non-env dir)
+            if fn.endswith(".generating"):
+                try:
+                    os.remove(full)
+                    _LOG.info("removed orphan snapshot temp %s", fn)
+                except OSError:
+                    pass
+            elif fn.endswith(".gbsnap") and fn != live_name:
+                try:
+                    os.remove(full)
+                    _LOG.info("removed superseded snapshot %s", fn)
+                except OSError:
+                    pass
+
     def _snapshot_path(self, index: int) -> str:
         return os.path.join(
             self.snapshot_dir,
@@ -559,7 +587,11 @@ class Node:
             on_disk_index=(index if self.sm.sm_type == pb.StateMachineType.ON_DISK
                            else 0),
         )
-        if not req.exported:
+        if req.exported:
+            from dragonboat_tpu.tools import write_export_metadata
+
+            write_export_metadata(path, ss)
+        else:
             self.logdb.save_snapshots([pb.Update(
                 shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
             )])

@@ -100,7 +100,19 @@ class NodeHost:
                 nhconfig.logdb_factory.create()  # type: ignore[union-attr]
                 if nhconfig.logdb_factory else MemLogDB()
             )
-        self.registry = Registry()
+        if nhconfig.address_by_node_host_id:
+            # dynamic addressing: targets are NodeHostIDs, resolved through
+            # the gossip view (registry/gossip.go:99)
+            from dragonboat_tpu.gossip import GossipManager, GossipRegistry
+
+            self.registry = GossipRegistry(GossipManager(
+                self.id, nhconfig.raft_address,
+                nhconfig.gossip.bind_address,
+                nhconfig.gossip.advertise_address,
+                list(nhconfig.gossip.seed),
+            ))
+        else:
+            self.registry = Registry()
         self.events = EventHub(
             raft_listener=nhconfig.raft_event_listener,
             system_listener=nhconfig.system_event_listener,
@@ -154,6 +166,9 @@ class NodeHost:
         self.transport.close()
         self.logdb.close()
         self.events.close()
+        close_registry = getattr(self.registry, "close", None)
+        if close_registry is not None:
+            close_registry()
         if self.env is not None:
             self.env.close()
 
@@ -179,7 +194,8 @@ class NodeHost:
                     raise RequestError("initial members mismatch")
             user_sm = create_sm(cfg.shard_id, cfg.replica_id)
             sm = StateMachine(cfg.shard_id, cfg.replica_id, user_sm,
-                              cfg.ordered_config_change)
+                              cfg.ordered_config_change,
+                              cfg.snapshot_compression)
             snapshot_dir = (
                 self.env.snapshot_dir(cfg.shard_id, cfg.replica_id)
                 if self.env is not None
@@ -408,8 +424,11 @@ class NodeHost:
         if batch.deployment_id != self.config.deployment_id:
             return  # transport.go:306-311 deployment-id gate
         # learn the sender's address so responses resolve even before any
-        # membership entry applies locally (transport.go:317-324)
-        if batch.source_address:
+        # membership entry applies locally (transport.go:317-324).  Not in
+        # gossip mode: targets there are NodeHostIDs, and pinning a raw
+        # address would permanently bypass gossip re-resolution after the
+        # sender moves
+        if batch.source_address and not self.config.address_by_node_host_id:
             for m in batch.requests:
                 if m.from_ != 0:
                     self.registry.add(m.shard_id, m.from_, batch.source_address)

@@ -1,14 +1,20 @@
 """Versioned snapshot file format with per-block CRCs.
 
-Parity with the reference's V2 snapshot format (``internal/rsm/snapshotio.go``
-header + ``rwv.go`` block writer/validator): a fixed header (version, sizes,
-checksum type, header CRC), a session payload, the user-SM payload written in
-CRC-framed blocks, and a footer with the payload checksum.  Corrupt blocks
-fail recovery instead of feeding bad state to the SM.
+Parity with the reference's snapshot formats (``internal/rsm/snapshotio.go``
+header + ``rwv.go`` block writer/validator + ``encoded.go`` compression):
+a fixed header (version, sizes, checksum type, header CRC), a session
+payload, the user-SM payload written in CRC-framed blocks, and a footer
+with the payload checksum.  Corrupt blocks fail recovery instead of
+feeding bad state to the SM.
 
-Layout (little-endian):
+V2 layout (little-endian):
   magic "DBTPUSNP" | u32 version | u32 header_crc | u64 session_len
   | session bytes | blocks: [u32 len | u32 crc | bytes]* | u32 0 terminator
+
+V3 adds the compression envelope (encoded.go analog): each block frame is
+[u32 stored_len | u32 crc(stored) | u8 compressed | stored bytes], where
+compressed blocks hold zlib(raw).  The payload checksum covers the RAW
+bytes, so V2 and V3 of the same payload verify identically.
 """
 
 from __future__ import annotations
@@ -20,7 +26,11 @@ from typing import BinaryIO
 
 MAGIC = b"DBTPUSNP"
 V2 = 2
+V3 = 3
 BLOCK_SIZE = 256 * 1024
+# only compress when it actually shrinks the block by a margin (skip
+# incompressible payloads rather than pay decompress for nothing)
+_MIN_GAIN = 0.9
 
 
 class SnapshotFormatError(ValueError):
@@ -28,11 +38,13 @@ class SnapshotFormatError(ValueError):
 
 
 class BlockWriter:
-    """CRC-framed block writer (rwv.go IVWriter)."""
+    """CRC-framed block writer (rwv.go IVWriter; V3 adds compression)."""
 
-    def __init__(self, f: BinaryIO, block_size: int = BLOCK_SIZE) -> None:
+    def __init__(self, f: BinaryIO, block_size: int = BLOCK_SIZE,
+                 compress: bool = False) -> None:
         self.f = f
         self.block_size = block_size
+        self.compress = compress
         self.buf = bytearray()
         self.payload_crc = 0
 
@@ -45,8 +57,17 @@ class BlockWriter:
 
     def _flush_block(self, block: bytes) -> None:
         self.payload_crc = zlib.crc32(block, self.payload_crc)
-        self.f.write(struct.pack("<II", len(block), zlib.crc32(block)))
-        self.f.write(block)
+        if not self.compress:
+            self.f.write(struct.pack("<II", len(block), zlib.crc32(block)))
+            self.f.write(block)
+            return
+        packed = zlib.compress(block, 1)
+        stored, flag = ((packed, 1)
+                        if len(packed) < len(block) * _MIN_GAIN
+                        else (block, 0))
+        self.f.write(struct.pack("<IIB", len(stored), zlib.crc32(stored),
+                                 flag))
+        self.f.write(stored)
 
     def close(self) -> None:
         if self.buf:
@@ -59,8 +80,9 @@ class BlockWriter:
 class BlockReader:
     """Validating reader over CRC-framed blocks (rwv.go IVReader)."""
 
-    def __init__(self, f: BinaryIO) -> None:
+    def __init__(self, f: BinaryIO, version: int = V2) -> None:
         self.f = f
+        self.version = version
         self.payload_crc = 0
         self.buf = bytearray()
         self.eof = False
@@ -76,10 +98,15 @@ class BlockReader:
                 raise SnapshotFormatError("payload checksum mismatch")
             self.eof = True
             return
-        (crc,) = struct.unpack("<I", self.f.read(4))
-        block = self.f.read(ln)
-        if len(block) != ln or zlib.crc32(block) != crc:
+        if self.version >= V3:
+            crc, flag = struct.unpack("<IB", self.f.read(5))
+        else:
+            (crc,) = struct.unpack("<I", self.f.read(4))
+            flag = 0
+        stored = self.f.read(ln)
+        if len(stored) != ln or zlib.crc32(stored) != crc:
             raise SnapshotFormatError("block checksum mismatch")
+        block = zlib.decompress(stored) if flag else stored
         self.payload_crc = zlib.crc32(block, self.payload_crc)
         self.buf += block
 
@@ -98,16 +125,16 @@ class BlockReader:
 
 
 def write_snapshot(f: BinaryIO, session_data: bytes,
-                   write_payload) -> None:
+                   write_payload, compress: bool = False) -> None:
     """write_payload(w) receives a BlockWriter for the SM payload."""
     header = struct.pack("<Q", len(session_data))
     f.write(MAGIC)
-    f.write(struct.pack("<I", V2))
+    f.write(struct.pack("<I", V3 if compress else V2))
     f.write(struct.pack("<I", zlib.crc32(header)))
     f.write(header)
     f.write(struct.pack("<I", zlib.crc32(session_data)))
     f.write(session_data)
-    w = BlockWriter(f)
+    w = BlockWriter(f, compress=compress)
     write_payload(w)
     w.close()
 
@@ -117,7 +144,7 @@ def read_snapshot(f: BinaryIO):
     if f.read(8) != MAGIC:
         raise SnapshotFormatError("bad magic")
     (version,) = struct.unpack("<I", f.read(4))
-    if version != V2:
+    if version not in (V2, V3):
         raise SnapshotFormatError(f"unsupported version {version}")
     (hcrc,) = struct.unpack("<I", f.read(4))
     header = f.read(8)
@@ -128,4 +155,4 @@ def read_snapshot(f: BinaryIO):
     session = f.read(slen)
     if zlib.crc32(session) != scrc:
         raise SnapshotFormatError("session checksum mismatch")
-    return session, BlockReader(f)
+    return session, BlockReader(f, version=version)

@@ -59,9 +59,11 @@ class StateMachine:
         replica_id: int,
         user_sm: object,
         ordered_config_change: bool = False,
+        compress_snapshots: bool = False,
     ) -> None:
         self.shard_id = shard_id
         self.replica_id = replica_id
+        self.compress_snapshots = compress_snapshots
         self.sm = user_sm
         self.sm_type = sm_api.sm_type_of(user_sm)
         self.sessions = LRUSession()
@@ -185,7 +187,8 @@ class StateMachine:
 
             tmp = path + ".generating"
             with open(tmp, "wb") as f:
-                write_snapshot(f, session_data, write_payload)
+                write_snapshot(f, session_data, write_payload,
+                               compress=self.compress_snapshots)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)

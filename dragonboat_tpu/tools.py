@@ -1,0 +1,113 @@
+"""tools — offline cluster repair utilities.
+
+Parity with the reference's ``tools`` package, chiefly ImportSnapshot
+(tools/import.go:134): when a shard has permanently lost its quorum, an
+exported snapshot is imported into selected node-host data dirs with a
+REWRITTEN membership, so the survivors restart as a fresh quorum holding
+the old state machine data.
+
+Exported snapshots (``sync_request_snapshot(export_path=...)``) are the
+SM image file plus a JSON metadata sidecar (``<path>.meta.json``) holding
+index/term/membership/shard — the analog of the reference's exported
+snapshot dir with its flag file (tools/import.go getSnapshotRecord).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import NodeHostConfig
+from dragonboat_tpu.logdb.tan import TanLogDB
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.server.env import Env
+
+_LOG = get_logger("tools")
+
+META_SUFFIX = ".meta.json"
+
+
+def write_export_metadata(path: str, ss: pb.Snapshot) -> None:
+    """Sidecar written next to an exported snapshot image."""
+    meta = {
+        "shard_id": ss.shard_id,
+        "index": ss.index,
+        "term": ss.term,
+        "type": int(ss.type),
+        "membership": {
+            "config_change_id": ss.membership.config_change_id,
+            "addresses": {str(k): v
+                          for k, v in ss.membership.addresses.items()},
+            "non_votings": {str(k): v
+                            for k, v in ss.membership.non_votings.items()},
+            "witnesses": {str(k): v
+                          for k, v in ss.membership.witnesses.items()},
+        },
+    }
+    tmp = path + META_SUFFIX + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path + META_SUFFIX)
+
+
+def read_export_metadata(path: str) -> dict:
+    with open(path + META_SUFFIX) as f:
+        return json.load(f)
+
+
+def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
+                    members: dict[int, str], replica_id: int) -> None:
+    """ImportSnapshot (tools/import.go:134): place an exported snapshot
+    into ``replica_id``'s data dir with membership REWRITTEN to
+    ``members``, so the next ``start_replica`` restarts from it.
+
+    Must run while the target NodeHost is DOWN (the env lock enforces
+    this).  Every member of ``members`` must run the same import against
+    its own data dir before any of them restarts."""
+    if replica_id not in members:
+        raise ValueError(f"replica {replica_id} not in the new membership")
+    meta = read_export_metadata(src_path)
+    membership = pb.Membership(
+        config_change_id=meta["index"],
+        addresses=dict(members),
+    )
+    env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
+              nhconfig.deployment_id)
+    env.lock()
+    try:
+        env.check_node_host_dir("tan")
+        shard_id = int(meta["shard_id"])
+        # place the image in the replica's snapshot dir
+        dst_dir = env.snapshot_dir(shard_id, replica_id)
+        index = int(meta["index"])
+        dst = os.path.join(
+            dst_dir,
+            f"snapshot-{shard_id:016X}-{replica_id:016X}-{index:016X}"
+            ".gbsnap")
+        shutil.copyfile(src_path, dst)
+        ss = pb.Snapshot(
+            filepath=dst,
+            file_size=os.path.getsize(dst),
+            index=index,
+            term=int(meta["term"]),
+            membership=membership,
+            shard_id=shard_id,
+            type=pb.StateMachineType(meta.get("type", 0)),
+            imported=True,
+        )
+        # rebuild the replica's log-db state around the imported snapshot:
+        # drop old state, stamp the snapshot + bootstrap (import.go main
+        # flow: ssEnv.FinalizeSnapshot + logdb writes)
+        db = TanLogDB(env.logdb_dir)
+        try:
+            db.import_snapshot(ss, replica_id)
+        finally:
+            db.close()
+        _LOG.info("imported snapshot idx=%d for shard %d replica %d into %s",
+                  index, shard_id, replica_id, env.root)
+    finally:
+        env.close()

@@ -1,0 +1,122 @@
+"""Gossip registry: NodeHostID-based dynamic addressing
+(internal/registry/gossip.go behavior over a self-contained UDP
+anti-entropy protocol).
+"""
+
+import socket
+import time
+
+import pytest
+
+from dragonboat_tpu.config import Config, GossipConfig, NodeHostConfig
+from dragonboat_tpu.gossip import GossipManager, GossipRegistry
+from dragonboat_tpu.nodehost import NodeHost
+
+from test_nodehost import KVStateMachine, wait_leader
+
+
+def free_udp_ports(n):
+    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+             for _ in range(n)]
+    ports = []
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_gossip_view_propagates():
+    p1, p2, p3 = free_udp_ports(3)
+    m1 = GossipManager("nhid-a", "addr-a:1", f"127.0.0.1:{p1}")
+    m2 = GossipManager("nhid-b", "addr-b:1", f"127.0.0.1:{p2}",
+                       seeds=[f"127.0.0.1:{p1}"])
+    m3 = GossipManager("nhid-c", "addr-c:1", f"127.0.0.1:{p3}",
+                       seeds=[f"127.0.0.1:{p1}"])
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(m.lookup("nhid-a") and m.lookup("nhid-b")
+                   and m.lookup("nhid-c") for m in (m1, m2, m3)):
+                break
+            time.sleep(0.05)
+        for m in (m1, m2, m3):
+            assert m.lookup("nhid-a") == "addr-a:1"
+            assert m.lookup("nhid-b") == "addr-b:1"
+            assert m.lookup("nhid-c") == "addr-c:1"
+        # address change re-advertises with a newer version
+        m2.set_raft_address("addr-b:2")
+        deadline = time.time() + 10
+        while time.time() < deadline and m1.lookup("nhid-b") != "addr-b:2":
+            time.sleep(0.05)
+        assert m1.lookup("nhid-b") == "addr-b:2"
+    finally:
+        for m in (m1, m2, m3):
+            m.close()
+
+
+def test_gossip_registry_resolves_nhid():
+    p1, p2 = free_udp_ports(2)
+    m1 = GossipManager("nhid-x", "real-addr:7", f"127.0.0.1:{p1}")
+    m2 = GossipManager("nhid-y", "other:9", f"127.0.0.1:{p2}",
+                       seeds=[f"127.0.0.1:{p1}"])
+    reg = GossipRegistry(m2)
+    try:
+        reg.add(5, 1, "nhid-x")
+        reg.add(5, 2, "plain-addr:3")   # non-nhid targets pass through
+        deadline = time.time() + 10
+        addr = None
+        while time.time() < deadline:
+            try:
+                addr, _ = reg.resolve(5, 1)
+                break
+            except KeyError:
+                time.sleep(0.05)
+        assert addr == "real-addr:7"
+        assert reg.resolve(5, 2)[0] == "plain-addr:3"
+    finally:
+        m1.close()
+        reg.close()
+
+
+def test_cluster_over_nhid_addressing():
+    """Full E2E: initial members are NodeHostIDs; gossip resolves them to
+    chan-transport addresses; the cluster elects and serves."""
+    ports = free_udp_ports(3)
+    seed = [f"127.0.0.1:{ports[0]}"]
+    hosts = {}
+    for i, port in enumerate(ports, start=1):
+        nh = NodeHost(NodeHostConfig(
+            raft_address=f"gsp-{i}", rtt_millisecond=5,
+            address_by_node_host_id=True,
+            gossip=GossipConfig(bind_address=f"127.0.0.1:{port}",
+                                seed=list(seed)),
+        ))
+        hosts[i] = nh
+    members = {i: hosts[i].id for i in hosts}   # rid -> NodeHostID
+    try:
+        for rid, nh in hosts.items():
+            nh.start_replica(members, False, KVStateMachine, Config(
+                shard_id=1, replica_id=rid, election_rtt=10,
+                heartbeat_rtt=1))
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        nh.sync_propose(sess, b"dyn=addr", timeout_s=10)
+        assert nh.sync_read(1, "dyn", timeout_s=10) == "addr"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(h.stale_read(1, "dyn") == "addr" for h in hosts.values()):
+                break
+            time.sleep(0.05)
+        assert all(h.stale_read(1, "dyn") == "addr" for h in hosts.values())
+    finally:
+        for nh in hosts.values():
+            nh.close()
+
+
+def test_gossip_required_for_nhid_addressing():
+    with pytest.raises(Exception):
+        NodeHost(NodeHostConfig(raft_address="x-1",
+                                address_by_node_host_id=True))
