@@ -580,6 +580,7 @@ class KernelEngine:
                         n.pending_config_change.done(
                             entry.key, RequestResultCode.DROPPED)
                     else:
+                        n._rl_release(entry.key)
                         n.pending_proposals.dropped(entry.key)
             n._staged_props = []
 
@@ -741,6 +742,13 @@ class KernelEngine:
                 e = pb.Entry(index=idx, term=int(o["term"][g]))
                 n.mirror[idx] = e
             entries.append(e)
+        for e in entries:
+            if e.key:
+                n._rl_release(e.key)
+        if n.notify_commit:
+            for e in entries:
+                if e.key:
+                    n.pending_proposals.committed(e.key)
         results = n.sm.handle(entries)
         cc_applied = False
         for r in results:

@@ -99,9 +99,12 @@ class GossipManager:
                 continue
             try:
                 msg = json.loads(data.decode())
-            except ValueError:
-                continue
-            self._merge(msg)
+                if isinstance(msg, dict):
+                    self._merge(msg)
+            except Exception:
+                # a malformed datagram must never kill the gossip thread
+                _LOG.debug("dropping malformed gossip datagram from %s",
+                           addr, exc_info=True)
 
     def _push(self) -> None:
         payload = self._payload()
@@ -113,16 +116,29 @@ class GossipManager:
         for t in targets:
             try:
                 self.sock.sendto(payload, _parse(t))
-            except OSError:
+            except (OSError, ValueError):
                 pass
 
     def _merge(self, msg: dict) -> None:
         src = msg.get("from")
         now = time.monotonic()
+        view = msg.get("view")
+        if not isinstance(view, dict):
+            view = {}
         with self.mu:
-            if src and src != self.advertise:
-                self.members[src] = now
-            for nhid, rec in (msg.get("view") or {}).items():
+            if isinstance(src, str) and src != self.advertise:
+                try:
+                    _parse(src)  # only track pushable member addresses
+                    self.members[src] = now
+                except ValueError:
+                    pass
+            for nhid, rec in view.items():
+                if nhid == self.nhid:
+                    # the local record is authoritative here — a stale
+                    # echo (e.g. after a clock step) must not overwrite
+                    # our own advertised address (memberlist's local-node
+                    # special case)
+                    continue
                 try:
                     addr, version = rec[0], int(rec[1])
                 except (TypeError, ValueError, IndexError):

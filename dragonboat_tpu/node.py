@@ -27,10 +27,13 @@ from dragonboat_tpu.request import (
     PendingProposal,
     PendingReadIndex,
     PendingSingleton,
+    RequestDroppedError,
     RequestResultCode,
     RequestState,
 )
 from dragonboat_tpu.rsm.statemachine import StateMachine
+from dragonboat_tpu.server.rate import RateLimiter
+from dragonboat_tpu.server.settings import soft
 from dragonboat_tpu.statemachine import Result
 
 _LOG = get_logger("node")
@@ -101,6 +104,17 @@ class Node:
         self._last_leader: tuple[int, int] = (0, 0)
         # requestCompaction seam (node.go:972 getCompactedTo)
         self.compacted_to = 0
+        # in-memory log growth guard (server/rate.go + Config
+        # MaxInMemLogSize): unapplied proposal bytes; over the limit ->
+        # proposals rejected with system-busy until applies drain it.
+        # Accounting is keyed by proposal key so only bytes that were
+        # increased are ever decreased (drops and remote entries must not
+        # erode other proposals' accounting)
+        self.rate_limiter = RateLimiter(cfg.max_in_mem_log_size)
+        self._rl_inflight: dict[int, int] = {}
+        # NotifyCommit (nodehost.go:1656): fire committed_event on commit,
+        # before apply — set by NodeHost from NodeHostConfig
+        self.notify_commit = False
 
         self.peer: Peer | None = None
         self.stopped = False
@@ -214,19 +228,51 @@ class Node:
         with self.mu:
             mutate(self)
 
+    def _check_ingress(self) -> None:
+        """System-busy gates before a proposal is accepted: the in-mem
+        rate limiter (request.go canNewRequest + rate.go) and the bounded
+        entry queue (queue.go:24 entryQueue capacity)."""
+        if self.rate_limiter.rate_limited():
+            raise RequestDroppedError("system busy: in-memory log limit")
+        with self.mu:
+            if len(self.incoming_proposals) >= \
+                    soft.incoming_proposal_queue_length:
+                raise RequestDroppedError("system busy: proposal queue full")
+
     def propose(self, session: Session, cmd: bytes,
                 timeout_ticks: int) -> RequestState:
+        self._check_ingress()
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        if self.rate_limiter.enabled():
+            sz = pb.entry_size(entry)
+            self.rate_limiter.increase(sz)
+            with self.mu:
+                self._rl_inflight[entry.key] = sz
         self._post(lambda n: n.incoming_proposals.append(entry))
         return rs
 
+    def _rl_release(self, key: int) -> None:
+        """Release a proposal's rate-limiter bytes exactly once (on apply
+        OR on drop — whichever settles it)."""
+        if not self.rate_limiter.enabled():
+            return
+        with self.mu:
+            sz = self._rl_inflight.pop(key, None)
+        if sz is not None:
+            self.rate_limiter.decrease(sz)
+
     def propose_session_op(self, session: Session,
                            timeout_ticks: int) -> RequestState:
+        self._check_ingress()
         rs, entry = self.pending_proposals.propose(session, b"", timeout_ticks)
         self._post(lambda n: n.incoming_proposals.append(entry))
         return rs
 
     def read(self, timeout_ticks: int) -> RequestState:
+        with self.mu:
+            if len(self.pending_reads.batching) >= \
+                    soft.incoming_read_index_queue_length:
+                raise RequestDroppedError("system busy: read queue full")
         return self.pending_reads.read(timeout_ticks)
 
     def request_config_change(self, cc: pb.ConfigChange,
@@ -394,9 +440,16 @@ class Node:
                 self._send(m)
         # dropped ops
         for e in ud.dropped_entries:
+            self._rl_release(e.key)
             self.pending_proposals.dropped(e.key)
         for sc in ud.dropped_read_indexes:
             self.pending_reads.dropped(sc)
+        # NotifyCommit: complete committed_event at commit time, before
+        # apply (node.go:1062 notifyCommittedEntries)
+        if self.notify_commit:
+            for e in ud.committed_entries:
+                if e.key:
+                    self.pending_proposals.committed(e.key)
         # ready-to-read contexts; fire immediately when the applied index
         # already covers the read index (request.go:930 applied())
         for rtr in ud.ready_to_reads:
@@ -418,6 +471,9 @@ class Node:
         self.send_message(m)
 
     def _apply_entries(self, entries) -> None:
+        for e in entries:
+            if e.key:
+                self._rl_release(e.key)
         results = self.sm.handle(entries)
         for r in results:
             entry = next(e for e in entries if e.index == r.index)

@@ -143,11 +143,27 @@ class NodeHost:
         # the batched device engine, created on the first device-resident
         # shard (engine/kernel_engine.py)
         self.kernel_engine = None
+        # partitioned step workers (engine.go:1107 workerPool: shards hash
+        # onto fixed workers so each node is stepped by exactly one
+        # thread; fsyncs of different partitions overlap)
+        import os as _os
+
+        self._num_workers = max(1, min(
+            nhconfig.expert.engine.exec_shards, _os.cpu_count() or 1, 8))
+        self._worker_events = [threading.Event()
+                               for _ in range(self._num_workers)]
+        self._workers: list[threading.Thread] = []
         if auto_run:
             self._engine_thread = threading.Thread(
                 target=self._engine_main, name=f"engine-{self.id[:12]}",
                 daemon=True)
             self._engine_thread.start()
+            for w in range(self._num_workers):
+                t = threading.Thread(target=self._worker_main, args=(w,),
+                                     name=f"exec-{w}-{self.id[:8]}",
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -158,8 +174,12 @@ class NodeHost:
             nodes = list(self.nodes.values())
             self.nodes.clear()
         self._work.set()
+        for ev in self._worker_events:
+            ev.set()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout=5)
+        for t in self._workers:
+            t.join(timeout=5)
         for n in nodes:
             n.destroy()
             self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
@@ -212,6 +232,7 @@ class NodeHost:
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
+            node.notify_commit = self.config.notify_commit
             members = initial_members if not join else {}
             node.start(members, initial=not join, new_node=new_node)
             for rid, addr in (members or {}).items():
@@ -328,7 +349,7 @@ class NodeHost:
         for attr in ("pending_proposals", "pending_reads",
                      "pending_config_change", "pending_snapshot",
                      "pending_transfer", "pending_log_query",
-                     "pending_compaction"):
+                     "pending_compaction", "rate_limiter", "notify_commit"):
             setattr(node, attr, getattr(knode, attr))
         node.start({}, initial=False, new_node=False)
         for m in carry:
@@ -363,6 +384,8 @@ class NodeHost:
     # -- engine ---------------------------------------------------------
 
     def _engine_main(self) -> None:
+        """Ticker + work fan-out (the reference's nodeTicker plus the
+        signal side of the worker ready queues, engine.go:1107+)."""
         last_tick = time.monotonic()
         while not self._stopped:
             self._work.wait(timeout=self._tick_interval / 4)
@@ -375,7 +398,38 @@ class NodeHost:
                 for n in nodes:
                     n.tick()
                 self.chunk_sink.tick()
-            self.run_once()
+            for ev in self._worker_events:
+                ev.set()
+
+    def _worker_main(self, w: int) -> None:
+        """One step worker: advances the shards hashed to partition w
+        (shard_id % workers), plus the kernel engine on worker 0."""
+        ev = self._worker_events[w]
+        while not self._stopped:
+            ev.wait(timeout=self._tick_interval / 2)
+            ev.clear()
+            progressed = True
+            while progressed and not self._stopped:
+                progressed = False
+                with self.mu:
+                    nodes = [n for sid, n in self.nodes.items()
+                             if sid % self._num_workers == w]
+                for n in nodes:
+                    try:
+                        if n.step():
+                            progressed = True
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                if w == 0 and self.kernel_engine is not None:
+                    try:
+                        if self.kernel_engine.step_all():
+                            progressed = True
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
 
     def run_once(self) -> int:
         """Step every node until quiescent; returns steps executed."""

@@ -65,8 +65,10 @@ class BlockWriter:
         stored, flag = ((packed, 1)
                         if len(packed) < len(block) * _MIN_GAIN
                         else (block, 0))
-        self.f.write(struct.pack("<IIB", len(stored), zlib.crc32(stored),
-                                 flag))
+        # frame CRC covers the flag byte too — a flipped flag must fail
+        # validation, not reach zlib or stream wrong bytes to the SM
+        crc = zlib.crc32(stored, zlib.crc32(bytes([flag])))
+        self.f.write(struct.pack("<IIB", len(stored), crc, flag))
         self.f.write(stored)
 
     def close(self) -> None:
@@ -104,9 +106,14 @@ class BlockReader:
             (crc,) = struct.unpack("<I", self.f.read(4))
             flag = 0
         stored = self.f.read(ln)
-        if len(stored) != ln or zlib.crc32(stored) != crc:
+        expect = (zlib.crc32(stored, zlib.crc32(bytes([flag])))
+                  if self.version >= V3 else zlib.crc32(stored))
+        if len(stored) != ln or expect != crc:
             raise SnapshotFormatError("block checksum mismatch")
-        block = zlib.decompress(stored) if flag else stored
+        try:
+            block = zlib.decompress(stored) if flag else stored
+        except zlib.error as e:
+            raise SnapshotFormatError(f"corrupt compressed block: {e}")
         self.payload_crc = zlib.crc32(block, self.payload_crc)
         self.buf += block
 

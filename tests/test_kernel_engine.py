@@ -15,6 +15,24 @@ from dragonboat_tpu.nodehost import NodeHost
 from test_nodehost import KVStateMachine, wait_leader
 
 
+def propose_retry(nh, sess, cmd, timeout_s=10, deadline_s=20):
+    """sync_propose with retry on the transient not-ready/timeout drops
+    raft legitimately returns right after elections (ErrShardNotReady
+    semantics — the reference tells callers to retry)."""
+    import time as _t
+
+    from dragonboat_tpu.request import RequestDroppedError, RequestTimeoutError
+
+    end = _t.time() + deadline_s
+    while True:
+        try:
+            return nh.sync_propose(sess, cmd, timeout_s=timeout_s)
+        except (RequestDroppedError, RequestTimeoutError):
+            if _t.time() > end:
+                raise
+            _t.sleep(0.1)
+
+
 def make_cluster(prefix, n=3, snapshot_entries=0, rtt_ms=5, shards=(1,),
                  expert=None):
     addrs = {i: f"{prefix}-{i}" for i in range(1, n + 1)}
@@ -58,7 +76,7 @@ def test_kernel_propose_and_read():
         nh = hosts[lead]
         sess = nh.get_noop_session(1)
         for i in range(10):
-            nh.sync_propose(sess, f"k{i}=v{i}".encode(), timeout_s=10)
+            propose_retry(nh, sess, f"k{i}=v{i}".encode())
         assert nh.sync_read(1, "k7", timeout_s=10) == "v7"
         # replication reached the other hosts
         deadline = time.time() + 10
@@ -77,7 +95,7 @@ def test_kernel_read_from_follower_host():
     try:
         lead = wait_leader(hosts, timeout=30)
         nh = hosts[lead]
-        nh.sync_propose(nh.get_noop_session(1), b"fw=ok", timeout_s=10)
+        propose_retry(nh, nh.get_noop_session(1), b"fw=ok")
         follower = next(r for r in hosts if r != lead)
         deadline = time.time() + 10
         val = None
@@ -100,7 +118,7 @@ def test_kernel_snapshot_and_compaction():
         nh = hosts[lead]
         sess = nh.get_noop_session(1)
         for i in range(30):
-            nh.sync_propose(sess, f"s{i}=v{i}".encode(), timeout_s=10)
+            propose_retry(nh, sess, f"s{i}=v{i}".encode())
         # auto-snapshot fired on the leader
         deadline = time.time() + 10
         node = nh.nodes[1]
@@ -138,7 +156,7 @@ def test_kernel_eviction_to_host_engine():
         lead = wait_leader(hosts, timeout=30)
         nh = hosts[lead]
         sess = nh.get_noop_session(1)
-        nh.sync_propose(sess, b"pre=evict", timeout_s=10)
+        propose_retry(nh, sess, b"pre=evict")
         knode = nh.kernel_engine.by_shard[1]
         with nh.kernel_engine.mu:
             nh.kernel_engine._evict(knode, reason="test")
@@ -182,7 +200,7 @@ def test_kernel_restart_from_disk(tmp_path):
     nh = mk()
     sess = nh.get_noop_session(1)
     for i in range(15):
-        nh.sync_propose(sess, f"d{i}=v{i}".encode(), timeout_s=10)
+        propose_retry(nh, sess, f"d{i}=v{i}".encode())
     nh.close()
 
     nh = mk()
@@ -194,7 +212,7 @@ def test_kernel_restart_from_disk(tmp_path):
             time.sleep(0.05)
         for i in range(15):
             assert nh.stale_read(1, f"d{i}") == f"v{i}", i
-        nh.sync_propose(nh.get_noop_session(1), b"dz=zz", timeout_s=10)
+        propose_retry(nh, nh.get_noop_session(1), b"dz=zz")
         assert nh.sync_read(1, "dz", timeout_s=10) == "zz"
     finally:
         nh.close()

@@ -270,3 +270,70 @@ def test_event_listeners_fire():
     # shutdown events delivered before hub close
     assert "node_host_shutting_down" in rec_sys.names()
     assert "node_unloaded" in rec_sys.names()
+
+
+# ---------------------------------------------------------------------------
+# NotifyCommit + ingress guards (rate limiter, bounded queues)
+# ---------------------------------------------------------------------------
+
+
+def test_notify_commit_event_fires():
+    addrs = {i: f"nc-{i}" for i in (1, 2, 3)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=5,
+                                     notify_commit=True))
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1))
+        hosts[rid] = nh
+    try:
+        lead = wait_leader(hosts)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        rs = nh.propose(sess, b"nc=1")
+        assert rs.committed_event.wait(5.0), "commit notification missing"
+        r = rs.wait(5.0)
+        assert r.code.name == "COMPLETED"
+        sess.proposal_completed()
+    finally:
+        for h in hosts.values():
+            h.close()
+
+
+def test_rate_limiter_rejects_when_full():
+    from dragonboat_tpu.request import RequestDroppedError
+
+    addrs = {1: "rl-1"}
+    nh = NodeHost(NodeHostConfig(raft_address="rl-1", rtt_millisecond=5),
+                  auto_run=False)   # engine stopped: nothing drains
+    nh.start_replica(addrs, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1,
+        max_in_mem_log_size=256))
+    try:
+        node = nh.nodes[1]
+        sess = nh.get_noop_session(1)
+        with pytest.raises(RequestDroppedError):
+            for _ in range(64):
+                node.propose(sess, b"x" * 64, 100)
+        assert node.rate_limiter.rate_limited()
+    finally:
+        nh.close()
+
+
+def test_proposal_queue_bound():
+    from dragonboat_tpu.request import RequestDroppedError
+    from dragonboat_tpu.server.settings import soft
+
+    addrs = {1: "qb-1"}
+    nh = NodeHost(NodeHostConfig(raft_address="qb-1", rtt_millisecond=5),
+                  auto_run=False)
+    nh.start_replica(addrs, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+    try:
+        node = nh.nodes[1]
+        sess = nh.get_noop_session(1)
+        with pytest.raises(RequestDroppedError):
+            for _ in range(soft.incoming_proposal_queue_length + 8):
+                node.propose(sess, b"q", 100)
+    finally:
+        nh.close()
