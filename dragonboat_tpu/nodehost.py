@@ -135,6 +135,8 @@ class NodeHost:
             resolver=self.registry,
             unreachable_cb=self._on_unreachable,
             events=self.events,
+            snapshot_send_bps=nhconfig.max_snapshot_send_bytes_per_second,
+            max_send_queue_bytes=nhconfig.max_send_queue_size,
         )
         self._stopped = False
         self._work = threading.Event()

@@ -21,6 +21,11 @@ SEND_QUEUE_LEN = 1024 * 2
 BREAKER_RESET_SECONDS = 1.0
 
 
+def _msg_size(m: pb.Message) -> int:
+    """Approximate queued size (config.go MaxSendQueueSize accounting)."""
+    return 64 + sum(pb.entry_size(e) for e in m.entries)
+
+
 class CircuitBreaker:
     """Minimal failure breaker (transport.go GetCircuitBreaker)."""
 
@@ -52,7 +57,14 @@ class TransportHub:
         unreachable_cb: Callable[[pb.Message], None],
         sync: bool = True,
         events=None,
+        snapshot_send_bps: int = 0,
+        max_send_queue_bytes: int = 0,
     ) -> None:
+        self.snapshot_send_bps = snapshot_send_bps
+        # MaxSendQueueSize (config.go): BYTES of queued messages per
+        # target; 0 = unlimited. A full queue drops the NEW message and
+        # reports it (rate-limited), never silently evicts older ones
+        self.max_send_queue_bytes = max_send_queue_bytes
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.transport = transport
@@ -61,7 +73,8 @@ class TransportHub:
         self.sync = sync
         self.events = events if events is not None else EventHub()
         self.mu = threading.Lock()
-        self.queues: dict[str, deque[pb.Message]] = {}
+        self.queues: dict[str, deque[tuple[pb.Message, int]]] = {}
+        self.queue_bytes: dict[str, int] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
         # (addr, snapshot) -> last observed connection state; edge-triggered
         # listener events fire only on state changes (and first observation)
@@ -109,9 +122,17 @@ class TransportHub:
             self.metrics.inc("transport.dropped")
             self._notify_unreachable(m)
             return False
+        sz = _msg_size(m)
         with self.mu:
-            q = self.queues.setdefault(addr, deque(maxlen=SEND_QUEUE_LEN))
-            q.append(m)
+            q = self.queues.setdefault(addr, deque())
+            used = self.queue_bytes.get(addr, 0)
+            if (self.max_send_queue_bytes
+                    and used + sz > self.max_send_queue_bytes) \
+                    or len(q) >= SEND_QUEUE_LEN:
+                self.metrics.inc("transport.dropped")
+                return False
+            q.append((m, sz))
+            self.queue_bytes[addr] = used + sz
         if self.sync:
             self.flush(addr)
         return True
@@ -123,8 +144,9 @@ class TransportHub:
                 q = self.queues.get(a)
                 if not q:
                     continue
-                msgs = tuple(q)
+                msgs = tuple(m for m, _ in q)
                 q.clear()
+                self.queue_bytes[a] = 0
             batch = pb.MessageBatch(
                 requests=msgs,
                 deployment_id=self.deployment_id,
@@ -177,8 +199,19 @@ class TransportHub:
         self.events.send_snapshot_started(info)
         try:
             conn = self.transport.get_snapshot_connection(addr)
+            # MaxSnapshotSendBytesPerSecond (config.go): pace the stream so
+            # a large transfer cannot saturate the links raft traffic uses
+            bps = self.snapshot_send_bps
+            start, sent = time.monotonic(), 0
             for c in chunks:
                 conn.send_chunk(c)
+                if bps > 0:
+                    sent += len(getattr(c, "data", b""))
+                    while True:  # repay the whole deficit, in bounded naps
+                        ahead = sent / bps - (time.monotonic() - start)
+                        if ahead <= 0:
+                            break
+                        time.sleep(min(ahead, 1.0))
             b.succeed()
             self.metrics.inc("transport.snapshots_sent")
             self._note_connection(addr, True, True)

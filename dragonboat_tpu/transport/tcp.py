@@ -12,6 +12,7 @@ a payload CRC, mirroring requestHeader).
 from __future__ import annotations
 
 import socket
+import ssl
 import struct
 import threading
 import zlib
@@ -62,10 +63,17 @@ def _send_frame(sock: socket.socket, method: int, payload: bytes) -> None:
 class _TCPConn:
     """Cached outbound connection (TCPConnection, tcp.go:298)."""
 
-    def __init__(self, target: str) -> None:
+    def __init__(self, target: str,
+                 client_ctx: ssl.SSLContext | None = None) -> None:
         host, port = target.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=5)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if client_ctx is not None:
+            # mutual TLS (tcp.go getConnection → tls.Dial with the client
+            # certificate; the server name is not checked — the CA is the
+            # trust anchor, matching MutualTLS semantics)
+            sock = client_ctx.wrap_socket(sock, server_hostname=host)
+        self.sock = sock
         self.mu = threading.Lock()
 
     def close(self) -> None:
@@ -116,8 +124,16 @@ class _ConnProxy(IConnection):
 class TCPTransport(ITransport):
     """Listener + connection cache (NewTCPTransport, tcp.go:394)."""
 
-    def __init__(self, addr: str, message_handler, chunk_handler) -> None:
+    def __init__(self, addr: str, message_handler, chunk_handler,
+                 listen_addr: str = "",
+                 server_ctx: ssl.SSLContext | None = None,
+                 client_ctx: ssl.SSLContext | None = None) -> None:
         self.addr = addr
+        # ListenAddress (config.go): where to bind; RaftAddress is what is
+        # advertised to peers (NAT / 0.0.0.0 binds)
+        self.listen_addr = listen_addr or addr
+        self.server_ctx = server_ctx
+        self.client_ctx = client_ctx
         self.message_handler = message_handler
         self.chunk_handler = chunk_handler
         self.mu = threading.Lock()
@@ -130,7 +146,7 @@ class TCPTransport(ITransport):
         return "tcp-transport"
 
     def start(self) -> None:
-        host, port = self.addr.rsplit(":", 1)
+        host, port = self.listen_addr.rsplit(":", 1)
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((host, int(port)))
@@ -175,8 +191,31 @@ class TCPTransport(ITransport):
                              daemon=True).start()
 
     def _read_main(self, sock: socket.socket) -> None:
-        """Per-connection reader (tcp.go read loop)."""
+        """Per-connection reader (tcp.go read loop).  The TLS handshake
+        happens HERE, per connection with a timeout — in the accept loop a
+        stalled client would block every other peer's inbound path."""
         try:
+            if self.server_ctx is not None:
+                plain = sock
+                try:
+                    sock.settimeout(10.0)
+                    sock = self.server_ctx.wrap_socket(sock,
+                                                       server_side=True)
+                    sock.settimeout(None)
+                except (ssl.SSLError, OSError):
+                    return
+                finally:
+                    if sock is plain:   # handshake failed
+                        with self.mu:
+                            self._accepted.discard(plain)
+                        try:
+                            plain.close()
+                        except OSError:
+                            pass
+                    else:               # track the wrapped socket instead
+                        with self.mu:
+                            self._accepted.discard(plain)
+                            self._accepted.add(sock)
             while self.running:
                 raw = _recv_exact(sock, _REQ_HDR.size)
                 method, size, pcrc = _decode_header(raw)
@@ -203,7 +242,7 @@ class TCPTransport(ITransport):
         with self.mu:
             c = self.conns.get(target)
             if c is None:
-                c = self.conns[target] = _TCPConn(target)
+                c = self.conns[target] = _TCPConn(target, self.client_ctx)
             return c
 
     def _evict(self, target: str, conn: _TCPConn) -> None:
@@ -219,12 +258,33 @@ class TCPTransport(ITransport):
         return _ConnProxy(self, target)
 
 
+def _tls_contexts(nhconfig):
+    """Mutual-TLS contexts from NodeHostConfig (config.go MutualTLS +
+    CAFile/CertFile/KeyFile): both sides present certificates signed by
+    the shared CA and require the peer to do the same."""
+    if not nhconfig.mutual_tls:
+        return None, None
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(nhconfig.cert_file, nhconfig.key_file)
+    server.load_verify_locations(nhconfig.ca_file)
+    server.verify_mode = ssl.CERT_REQUIRED
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client.load_cert_chain(nhconfig.cert_file, nhconfig.key_file)
+    client.load_verify_locations(nhconfig.ca_file)
+    client.check_hostname = False   # CA-anchored trust, addresses move
+    client.verify_mode = ssl.CERT_REQUIRED
+    return server, client
+
+
 class TCPTransportFactory:
     """config.TransportFactory for real sockets (DefaultTransportFactory)."""
 
     def create(self, nhconfig, message_handler, chunk_handler) -> TCPTransport:
+        server_ctx, client_ctx = _tls_contexts(nhconfig)
         return TCPTransport(nhconfig.raft_address, message_handler,
-                            chunk_handler)
+                            chunk_handler,
+                            listen_addr=nhconfig.listen_address,
+                            server_ctx=server_ctx, client_ctx=client_ctx)
 
     def validate(self, addr: str) -> bool:
         try:
