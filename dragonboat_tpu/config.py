@@ -134,6 +134,11 @@ class NodeHostConfig:
                     "gossip must be configured for AddressByNodeHostID")
             if not self.gossip.bind_address:
                 raise ConfigError("gossip.bind_address not set")
+        if self.mutual_tls:
+            for field_name in ("ca_file", "cert_file", "key_file"):
+                if not getattr(self, field_name):
+                    raise ConfigError(
+                        f"MutualTLS requires {field_name} to be set")
 
     def prepare(self) -> None:
         if not self.node_host_dir:

@@ -65,6 +65,11 @@ class TransportHub:
         # target; 0 = unlimited. A full queue drops the NEW message and
         # reports it (rate-limited), never silently evicts older ones
         self.max_send_queue_bytes = max_send_queue_bytes
+        # shared snapshot-bandwidth bucket: the bytes/s cap is per HOST,
+        # so concurrent streams draw from one budget
+        self._snap_mu = threading.Lock()
+        self._snap_sent = 0
+        self._snap_start = 0.0
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.transport = transport
@@ -202,16 +207,10 @@ class TransportHub:
             # MaxSnapshotSendBytesPerSecond (config.go): pace the stream so
             # a large transfer cannot saturate the links raft traffic uses
             bps = self.snapshot_send_bps
-            start, sent = time.monotonic(), 0
             for c in chunks:
                 conn.send_chunk(c)
                 if bps > 0:
-                    sent += len(getattr(c, "data", b""))
-                    while True:  # repay the whole deficit, in bounded naps
-                        ahead = sent / bps - (time.monotonic() - start)
-                        if ahead <= 0:
-                            break
-                        time.sleep(min(ahead, 1.0))
+                    self._pace_snapshot(len(getattr(c, "data", b"")), bps)
             b.succeed()
             self.metrics.inc("transport.snapshots_sent")
             self._note_connection(addr, True, True)
@@ -224,6 +223,23 @@ class TransportHub:
             self._notify_unreachable(m)
             self._notify_snapshot_failed(m)
             return False
+
+    def _pace_snapshot(self, n: int, bps: int) -> None:
+        """Shared host-wide pacing (MaxSnapshotSendBytesPerSecond is the
+        NodeHost total): all streams draw from one budget.  The window
+        resets after idle so old credit can't fund a burst."""
+        while True:
+            now = time.monotonic()
+            with self._snap_mu:
+                if now - self._snap_start > 5.0 + self._snap_sent / bps:
+                    self._snap_start, self._snap_sent = now, 0
+                if n:
+                    self._snap_sent += n
+                    n = 0
+                ahead = self._snap_sent / bps - (now - self._snap_start)
+            if ahead <= 0:
+                return
+            time.sleep(min(ahead, 1.0))
 
     def _notify_snapshot_failed(self, m: pb.Message) -> None:
         """Feed a rejected SnapshotStatus back to the sender's raft

@@ -271,7 +271,11 @@ def _tls_contexts(nhconfig):
     client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     client.load_cert_chain(nhconfig.cert_file, nhconfig.key_file)
     client.load_verify_locations(nhconfig.ca_file)
-    client.check_hostname = False   # CA-anchored trust, addresses move
+    # full verification incl. the server identity (config.go:727 sets
+    # ServerName = target host): node certificates must carry the host
+    # in their SANs — a compromised key for one identity must not let
+    # its holder impersonate every other peer
+    client.check_hostname = True
     client.verify_mode = ssl.CERT_REQUIRED
     return server, client
 
