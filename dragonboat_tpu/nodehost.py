@@ -82,7 +82,8 @@ class NodeHost:
             # identity, snapshot placement) — a custom LogDB only swaps
             # the engine, as in the reference (config.LogDBFactory)
             self.env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
-                           nhconfig.deployment_id)
+                           nhconfig.deployment_id,
+                           wal_dir=nhconfig.wal_dir)
             self.env.lock()
             custom = logdb is not None or nhconfig.logdb_factory is not None
             if logdb is not None:

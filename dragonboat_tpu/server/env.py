@@ -61,24 +61,27 @@ class Env:
     """One NodeHost's view of its data directories."""
 
     def __init__(self, node_host_dir: str, raft_address: str,
-                 deployment_id: int = 0) -> None:
+                 deployment_id: int = 0, wal_dir: str = "") -> None:
         self.raft_address = raft_address
         self.deployment_id = deployment_id
         self.hostname = socket.gethostname()
-        self.root = os.path.join(
-            os.path.abspath(node_host_dir),
-            f"{deployment_id:020d}",
-            _sanitize(raft_address),
-        )
+        suffix = (f"{deployment_id:020d}", _sanitize(raft_address))
+        self.root = os.path.join(os.path.abspath(node_host_dir), *suffix)
+        # WALDir (config.go): optionally place the raft log on a separate
+        # (low-latency) volume; everything else stays under the root
+        self.wal_root = (os.path.join(os.path.abspath(wal_dir), *suffix)
+                         if wal_dir else self.root)
         os.makedirs(self.root, exist_ok=True)
-        self._lock_file = None
+        if self.wal_root != self.root:
+            os.makedirs(self.wal_root, exist_ok=True)
+        self._lock_files: list = []
         self._nhid: str | None = None
 
     # -- dirs -------------------------------------------------------------
 
     @property
     def logdb_dir(self) -> str:
-        d = os.path.join(self.root, "logdb")
+        d = os.path.join(self.wal_root, "logdb")
         os.makedirs(d, exist_ok=True)
         return d
 
@@ -112,31 +115,44 @@ class Env:
     # -- locking ----------------------------------------------------------
 
     def lock(self) -> None:
-        """LockNodeHostDir (:290): exclusive, non-blocking flock."""
-        if self._lock_file is not None:
+        """LockNodeHostDir (:290): exclusive, non-blocking flock on every
+        data root (the WAL volume included — two NodeHosts must never
+        share a log directory)."""
+        if self._lock_files:
             return
-        fp = os.path.join(self.root, LOCK_FILENAME)
-        f = open(fp, "a+")
-        try:
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
-            f.close()
-            raise DirLockedError(
-                f"failed to lock data directory {self.root}: another "
-                f"NodeHost is using it")
-        self._lock_file = f
+        dirs = [self.root]
+        if self.wal_root != self.root:
+            dirs.append(self.wal_root)
+        for d in dirs:
+            fp = os.path.join(d, LOCK_FILENAME)
+            f = open(fp, "a+")
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                f.close()
+                self.close()
+                raise DirLockedError(
+                    f"failed to lock data directory {d}: another "
+                    f"NodeHost is using it")
+            self._lock_files.append(f)
 
     def close(self) -> None:
-        if self._lock_file is not None:
-            fcntl.flock(self._lock_file.fileno(), fcntl.LOCK_UN)
-            self._lock_file.close()
-            self._lock_file = None
+        for f in self._lock_files:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                pass
+            f.close()
+        self._lock_files = []
 
     # -- flag file (dragonboat.ds) -----------------------------------------
 
     def check_node_host_dir(self, logdb_type: str) -> None:
-        """check (:390): create or validate the data-status flag file."""
-        fp = os.path.join(self.root, FLAG_FILENAME)
+        """check (:390): create or validate the data-status flag file, in
+        the root AND on the WAL volume (checkNodeHostDir validates both
+        data dirs).  The root flag records whether a separate WAL dir was
+        in use, so reopening with a changed wal_dir is refused instead of
+        silently starting from an empty raft log."""
         status = {
             "address": self.raft_address,
             "hostname": self.hostname,
@@ -144,7 +160,14 @@ class Env:
             "logdb_type": logdb_type,
             "bin_ver": BIN_VER,
             "hard_hash": hard.hash(),
+            "wal": self.wal_root if self.wal_root != self.root else "",
         }
+        self._check_dir(self.root, status)
+        if self.wal_root != self.root:
+            self._check_dir(self.wal_root, status)
+
+    def _check_dir(self, d: str, status: dict) -> None:
+        fp = os.path.join(d, FLAG_FILENAME)
         if not os.path.exists(fp):
             tmp = fp + ".tmp"
             with open(tmp, "w") as f:
@@ -158,7 +181,7 @@ class Env:
         if saved.get("address", "").strip().lower() != \
                 self.raft_address.strip().lower():
             raise NotOwnerError(
-                f"data dir {self.root} belongs to raft address "
+                f"data dir {d} belongs to raft address "
                 f"{saved.get('address')!r}, not {self.raft_address!r}")
         if saved.get("hostname") and saved["hostname"] != self.hostname:
             raise IncompatibleDataError(
@@ -167,9 +190,11 @@ class Env:
             raise IncompatibleDataError(
                 f"deployment id changed: {saved.get('deployment_id')} -> "
                 f"{self.deployment_id}")
-        if saved.get("logdb_type") and saved["logdb_type"] != logdb_type:
+        if saved.get("logdb_type") and \
+                saved["logdb_type"] != status["logdb_type"]:
             raise IncompatibleDataError(
-                f"LogDB type changed: {saved['logdb_type']} -> {logdb_type}")
+                f"LogDB type changed: {saved['logdb_type']} -> "
+                f"{status['logdb_type']}")
         if saved.get("bin_ver") != BIN_VER:
             raise IncompatibleDataError(
                 f"binary version changed: {saved.get('bin_ver')} -> {BIN_VER}")
@@ -177,6 +202,11 @@ class Env:
             raise IncompatibleDataError(
                 "hard settings changed since this deployment was created — "
                 "refusing to open (would corrupt data)")
+        if saved.get("wal", "") != status["wal"]:
+            raise IncompatibleDataError(
+                f"WALDir changed: {saved.get('wal') or '<none>'} -> "
+                f"{status['wal'] or '<none>'} — the raft log would be "
+                f"left behind")
 
     # -- identity ----------------------------------------------------------
 

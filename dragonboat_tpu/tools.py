@@ -76,7 +76,7 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
         addresses=dict(members),
     )
     env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
-              nhconfig.deployment_id)
+              nhconfig.deployment_id, wal_dir=nhconfig.wal_dir)
     env.lock()
     try:
         env.check_node_host_dir("tan")

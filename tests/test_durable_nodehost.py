@@ -210,3 +210,46 @@ def test_crash_kill_recovers_from_fsynced_log(tmp_path):
         assert nh.sync_read(1, "after") == "crash"
     finally:
         nh.close()
+
+
+def test_wal_dir_separates_log_volume(tmp_path):
+    """WALDir (config.go): the raft log lands on the WAL volume; the WAL
+    dir is locked and pinned in the flag file like the main dir."""
+    from dragonboat_tpu.server.env import IncompatibleDataError
+
+    cfg = NodeHostConfig(raft_address="wd-1", rtt_millisecond=5,
+                         node_host_dir=str(tmp_path / "main"),
+                         wal_dir=str(tmp_path / "wal"))
+    nh = NodeHost(cfg)
+    nh.start_replica({1: "wd-1"}, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+    deadline = time.time() + 10
+    while time.time() < deadline and not nh.get_leader_id(1)[1]:
+        time.sleep(0.02)
+    sess = nh.get_noop_session(1)
+    for i in range(5):
+        nh.sync_propose(sess, f"wl{i}=v{i}".encode())
+    logdb_dir = nh.env.logdb_dir
+    assert str(tmp_path / "wal") in logdb_dir
+    assert any(f.endswith(".tan") for f in os.listdir(logdb_dir))
+    # a second host sharing ONLY the WAL volume is excluded
+    with pytest.raises(DirLockedError):
+        NodeHost(NodeHostConfig(raft_address="wd-1", rtt_millisecond=5,
+                                node_host_dir=str(tmp_path / "other"),
+                                wal_dir=str(tmp_path / "wal")))
+    nh.close()
+    # dropping wal_dir on reopen is refused (the log would be left behind)
+    with pytest.raises(IncompatibleDataError):
+        NodeHost(NodeHostConfig(raft_address="wd-1", rtt_millisecond=5,
+                                node_host_dir=str(tmp_path / "main")))
+    # with the same wal_dir it reopens and recovers
+    nh = NodeHost(cfg)
+    nh.start_replica({}, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and nh.stale_read(1, "wl4") is None:
+            time.sleep(0.05)
+        assert nh.stale_read(1, "wl4") == "v4"
+    finally:
+        nh.close()
