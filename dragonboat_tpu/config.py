@@ -71,6 +71,9 @@ class EngineConfig:
 @dataclass
 class ExpertConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
+    # pluggable filesystem (config.go Expert.FS / vfs.IFS): OSFS by
+    # default; MemFS for diskless tests; ErrorFS for fault injection
+    fs: object | None = None
     # kernel geometry overrides (TPU-specific expert surface)
     kernel_log_cap: int = 1024
     kernel_inbox_cap: int = 8

@@ -135,7 +135,7 @@ class KernelNode(Node):
         durable log (node.go:739 doSave without the logreader cache)."""
         import os as _os
 
-        from dragonboat_tpu.raftio import EntryInfo, SnapshotInfo
+        from dragonboat_tpu.raftio import EntryInfo, SnapshotInfo  # noqa: F401
 
         index0 = self.sm.get_last_applied()
         if index0 == 0:
@@ -144,17 +144,17 @@ class KernelNode(Node):
                                            RequestResultCode.REJECTED)
             return
         path = req.path if req.exported else self._snapshot_path(index0)
-        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        self.fs.makedirs(_os.path.dirname(path) or ".")
         index, term, membership = self.sm.save_snapshot(path)
         ss = pb.Snapshot(
-            filepath=path, file_size=_os.path.getsize(path),
+            filepath=path, file_size=self.fs.getsize(path),
             index=index, term=term, membership=membership,
             shard_id=self.shard_id, type=self.sm.sm_type,
         )
         if req.exported:
             from dragonboat_tpu.tools import write_export_metadata
 
-            write_export_metadata(path, ss)
+            write_export_metadata(path, ss, fs=self.fs)
         else:
             self.logdb.save_snapshots([pb.Update(
                 shard_id=self.shard_id, replica_id=self.replica_id,

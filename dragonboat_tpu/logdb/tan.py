@@ -99,10 +99,14 @@ def _dec_update(shard_id: int, replica_id: int, data: bytes) -> pb.Update:
 class TanLogDB(ILogDB):
     """File-backed ILogDB; one instance owns one directory."""
 
-    def __init__(self, root_dir: str, max_file_size: int = 64 << 20) -> None:
+    def __init__(self, root_dir: str, max_file_size: int = 64 << 20,
+                 fs=None) -> None:
+        from dragonboat_tpu.vfs import default_fs
+
+        self.fs = fs if fs is not None else default_fs()
         self.root = root_dir
         self.max_file_size = max_file_size
-        os.makedirs(self.root, exist_ok=True)
+        self.fs.makedirs(self.root)
         self._mu = threading.RLock()
         self._nodes: dict[tuple[int, int], _Node] = {}
         # fileno -> set of node keys whose latest metadata lives there
@@ -124,7 +128,7 @@ class TanLogDB(ILogDB):
 
     def _lognames(self) -> list[int]:
         out = []
-        for fn in os.listdir(self.root):
+        for fn in self.fs.listdir(self.root):
             if fn.startswith("log-") and fn.endswith(".tan"):
                 out.append(int(fn[4:-4]))
         return sorted(out)
@@ -135,12 +139,12 @@ class TanLogDB(ILogDB):
 
     def _open_active(self, fileno: int) -> None:
         self._active_fileno = fileno
-        self._active = open(self._path(fileno), "ab")
+        self._active = self.fs.open(self._path(fileno), "ab")
 
     def _reader(self, fileno: int):
         f = self._readers.get(fileno)
         if f is None:
-            f = self._readers[fileno] = open(self._path(fileno), "rb")
+            f = self._readers[fileno] = self.fs.open(self._path(fileno), "rb")
         return f
 
     def _append(self, rectype: int, shard_id: int, replica_id: int,
@@ -156,15 +160,13 @@ class TanLogDB(ILogDB):
         return self._active_fileno, off
 
     def _rotate(self) -> None:
-        self._active.flush()
-        os.fsync(self._active.fileno())
+        self.fs.fsync(self._active)
         self._active.close()
         self._open_active(self._active_fileno + 1)
 
     def _sync(self) -> None:
         """THE fsync (engine.go:1343 SaveRaftState durability point)."""
-        self._active.flush()
-        os.fsync(self._active.fileno())
+        self.fs.fsync(self._active)
 
     # -- recovery --------------------------------------------------------
 
@@ -179,8 +181,8 @@ class TanLogDB(ILogDB):
 
     def _replay_file(self, fileno: int, truncate_tail: bool) -> None:
         path = self._path(fileno)
-        size = os.path.getsize(path)
-        with open(path, "rb") as f:
+        size = self.fs.getsize(path)
+        with self.fs.open(path, "rb") as f:
             off = 0
             while off + _HDR.size <= size:
                 hdr = f.read(_HDR.size)
@@ -192,7 +194,7 @@ class TanLogDB(ILogDB):
                         or zlib.crc32(payload) != crc)
                 if torn:
                     if truncate_tail:
-                        with open(path, "r+b") as tf:
+                        with self.fs.open(path, "r+b") as tf:
                             tf.truncate(off)
                         return
                     raise CorruptLogError(
@@ -263,8 +265,10 @@ class TanLogDB(ILogDB):
                 return
             self._closed = True
             if self._active is not None:
-                self._sync()
-                self._active.close()
+                try:
+                    self._sync()
+                finally:
+                    self._active.close()
             for f in self._readers.values():
                 f.close()
             self._readers.clear()
@@ -409,7 +413,7 @@ class TanLogDB(ILogDB):
             r = self._readers.pop(fileno, None)
             if r is not None:
                 r.close()
-            os.remove(self._path(fileno))
+            self.fs.remove(self._path(fileno))
             self._file_meta.pop(fileno, None)
             self._file_entries.pop(fileno, None)
 

@@ -58,7 +58,11 @@ class Node:
         snapshot_dir: str,
         rng=None,
         events: EventHub | None = None,
+        fs=None,
     ) -> None:
+        from dragonboat_tpu.vfs import default_fs
+
+        self.fs = fs if fs is not None else default_fs()
         self.cfg = cfg
         self.shard_id = cfg.shard_id
         self.replica_id = cfg.replica_id
@@ -592,24 +596,24 @@ class Node:
         half-written images (crash mid-save left a .generating temp) and
         committed-but-superseded snapshot files other than the recorded
         live one."""
-        if not os.path.isdir(self.snapshot_dir):
+        if not self.fs.exists(self.snapshot_dir):
             return
         live_name = (os.path.basename(live.filepath)
                      if live is not None and live.filepath else None)
         prefix = f"snapshot-{self.shard_id:016X}-{self.replica_id:016X}-"
-        for fn in os.listdir(self.snapshot_dir):
+        for fn in self.fs.listdir(self.snapshot_dir):
             full = os.path.join(self.snapshot_dir, fn)
             if not fn.startswith(prefix):
                 continue  # another shard's files (shared non-env dir)
             if fn.endswith(".generating"):
                 try:
-                    os.remove(full)
+                    self.fs.remove(full)
                     _LOG.info("removed orphan snapshot temp %s", fn)
                 except OSError:
                     pass
             elif fn.endswith(".gbsnap") and fn != live_name:
                 try:
-                    os.remove(full)
+                    self.fs.remove(full)
                     _LOG.info("removed superseded snapshot %s", fn)
                 except OSError:
                     pass
@@ -630,11 +634,11 @@ class Node:
                 self.pending_snapshot.done(req.key, RequestResultCode.REJECTED)
             return
         path = req.path if req.exported else self._snapshot_path(index0)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.fs.makedirs(os.path.dirname(path) or ".")
         index, term, membership = self.sm.save_snapshot(path)
         ss = pb.Snapshot(
             filepath=path,
-            file_size=os.path.getsize(path),
+            file_size=self.fs.getsize(path),
             index=index,
             term=term,
             membership=membership,
@@ -646,7 +650,7 @@ class Node:
         if req.exported:
             from dragonboat_tpu.tools import write_export_metadata
 
-            write_export_metadata(path, ss)
+            write_export_metadata(path, ss, fs=self.fs)
         else:
             self.logdb.save_snapshots([pb.Update(
                 shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss

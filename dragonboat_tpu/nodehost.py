@@ -41,6 +41,9 @@ from dragonboat_tpu.statemachine import Result
 from dragonboat_tpu.transport.chan import ChanTransportFactory
 from dragonboat_tpu.transport.chunks import ChunkSink
 from dragonboat_tpu.transport.hub import TransportHub
+from dragonboat_tpu.logger import get_logger
+
+_LOG = get_logger("nodehost")
 
 DEFAULT_TIMEOUT_S = 5.0
 
@@ -72,6 +75,10 @@ class NodeHost:
                  auto_run: bool = True) -> None:
         nhconfig.validate()
         self.config = nhconfig
+        from dragonboat_tpu.vfs import default_fs
+
+        self.fs = (nhconfig.expert.fs if nhconfig.expert.fs is not None
+                   else default_fs())
         # durable mode: with a NodeHostDir, the data dir is locked, the
         # flag file validated, identity persisted, and the tan log engine
         # is the default LogDB (nodehost.go NewNodeHost → server.NewEnv →
@@ -83,18 +90,28 @@ class NodeHost:
             # the engine, as in the reference (config.LogDBFactory)
             self.env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
                            nhconfig.deployment_id,
-                           wal_dir=nhconfig.wal_dir)
+                           wal_dir=nhconfig.wal_dir, fs=self.fs)
             self.env.lock()
-            custom = logdb is not None or nhconfig.logdb_factory is not None
-            if logdb is not None:
-                self.logdb: ILogDB = logdb
-            elif nhconfig.logdb_factory is not None:
-                self.logdb = nhconfig.logdb_factory.create()  # type: ignore[union-attr]
-            else:
-                self.logdb = TanLogDB(self.env.logdb_dir)
-            self.env.check_node_host_dir(
-                self.logdb.name() if custom else "tan")
-            self.id = self.env.node_host_id()
+            try:
+                custom = logdb is not None or nhconfig.logdb_factory is not None
+                if logdb is not None:
+                    self.logdb: ILogDB = logdb
+                    self.env.check_node_host_dir(self.logdb.name())
+                elif nhconfig.logdb_factory is not None:
+                    self.logdb = nhconfig.logdb_factory.create()  # type: ignore[union-attr]
+                    self.env.check_node_host_dir(self.logdb.name())
+                else:
+                    # validate the dir BEFORE tan touches the wal root so a
+                    # refused reopen leaves no stray log files behind
+                    self.env.check_node_host_dir("tan")
+                    self.logdb = TanLogDB(self.env.logdb_dir, fs=self.fs)
+                self.id = self.env.node_host_id()
+            except Exception:
+                db = getattr(self, "logdb", None)
+                if db is not None and db is not logdb:
+                    db.close()
+                self.env.close()
+                raise
         else:
             self.id = f"nhid-{uuid.uuid4()}"
             self.logdb = logdb if logdb is not None else (
@@ -140,6 +157,11 @@ class NodeHost:
             max_send_queue_bytes=nhconfig.max_send_queue_size,
         )
         self._stopped = False
+        # a storage-layer failure is a controlled crash (the reference arms
+        # an engine crash channel for injected FS errors, nodehost.go:361):
+        # the host stops accepting work and records the fault for the
+        # operator; restart from disk is the recovery path
+        self.fatal_error: Exception | None = None
         self._work = threading.Event()
         self._engine_thread: threading.Thread | None = None
         self._tick_interval = nhconfig.rtt_millisecond / 1000.0
@@ -187,7 +209,12 @@ class NodeHost:
             n.destroy()
             self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
         self.transport.close()
-        self.logdb.close()
+        try:
+            self.logdb.close()
+        except OSError:
+            # a storage fault mid-shutdown must not abort the close: the
+            # fsync that failed was already surfaced as fatal_error
+            _LOG.exception("logdb close failed")
         self.events.close()
         close_registry = getattr(self.registry, "close", None)
         if close_registry is not None:
@@ -218,7 +245,7 @@ class NodeHost:
             user_sm = create_sm(cfg.shard_id, cfg.replica_id)
             sm = StateMachine(cfg.shard_id, cfg.replica_id, user_sm,
                               cfg.ordered_config_change,
-                              cfg.snapshot_compression)
+                              cfg.snapshot_compression, fs=self.fs)
             snapshot_dir = (
                 self.env.snapshot_dir(cfg.shard_id, cfg.replica_id)
                 if self.env is not None
@@ -231,7 +258,7 @@ class NodeHost:
 
                 node_cls = KernelNode
             node = node_cls(cfg, self.logdb, sm, self._send_message,
-                            snapshot_dir, events=self.events)
+                            snapshot_dir, events=self.events, fs=self.fs)
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
@@ -345,7 +372,7 @@ class NodeHost:
             if self._stopped or self.nodes.get(cfg.shard_id) is not knode:
                 return  # stopped/replaced concurrently — do not resurrect
         node = Node(cfg, self.logdb, knode.sm, self._send_message,
-                    knode.snapshot_dir, events=self.events)
+                    knode.snapshot_dir, events=self.events, fs=self.fs)
         node.membership_changed_cb = (
             lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc))
         # transplant the books so callers' futures survive the move
@@ -421,18 +448,20 @@ class NodeHost:
                     try:
                         if n.step():
                             progressed = True
+                    except OSError as e:
+                        self._on_fatal(e)
+                        return
                     except Exception:
-                        import traceback
-
-                        traceback.print_exc()
+                        _LOG.exception("shard %d step failed", n.shard_id)
                 if w == 0 and self.kernel_engine is not None:
                     try:
                         if self.kernel_engine.step_all():
                             progressed = True
+                    except OSError as e:
+                        self._on_fatal(e)
+                        return
                     except Exception:
-                        import traceback
-
-                        traceback.print_exc()
+                        _LOG.exception("kernel engine step failed")
 
     def run_once(self) -> int:
         """Step every node until quiescent; returns steps executed."""
@@ -447,20 +476,37 @@ class NodeHost:
                     if n.step():
                         progressed = True
                         steps += 1
+                except OSError as e:
+                    self._on_fatal(e)
+                    return steps
                 except Exception:
-                    import traceback
-
-                    traceback.print_exc()
+                    _LOG.exception("shard %d step failed", n.shard_id)
             if self.kernel_engine is not None:
                 try:
                     if self.kernel_engine.step_all():
                         progressed = True
                         steps += 1
+                except OSError as e:
+                    self._on_fatal(e)
+                    return steps
                 except Exception:
-                    import traceback
-
-                    traceback.print_exc()
+                    _LOG.exception("kernel engine step failed")
         return steps
+
+    def _on_fatal(self, exc: Exception) -> None:
+        """Controlled crash on a storage failure: a raft log or snapshot
+        write that did not reach stable storage voids every ack sent after
+        it, so the host stops stepping immediately (the reference panics
+        the process; a library records the fault and halts —
+        nodehost.go:361-367 ErrorFS crash arming)."""
+        with self.mu:
+            if self.fatal_error is None:
+                self.fatal_error = exc
+            self._stopped = True
+        _LOG.critical("storage failure, halting NodeHost: %s", exc)
+        self._work.set()
+        for ev in self._worker_events:
+            ev.set()
 
     def tick_all(self) -> None:
         """Manual tick for auto_run=False test drivers."""
@@ -534,6 +580,11 @@ class NodeHost:
     # -- helpers ---------------------------------------------------------
 
     def _node(self, shard_id: int) -> Node:
+        # fail fast after a controlled crash: workers no longer step, so
+        # every request would otherwise ride its full timeout
+        if self.fatal_error is not None:
+            raise RequestError(
+                f"node host halted by storage failure: {self.fatal_error}")
         with self.mu:
             node = self.nodes.get(shard_id)
         if node is None:

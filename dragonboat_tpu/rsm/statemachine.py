@@ -60,7 +60,11 @@ class StateMachine:
         user_sm: object,
         ordered_config_change: bool = False,
         compress_snapshots: bool = False,
+        fs=None,
     ) -> None:
+        from dragonboat_tpu.vfs import default_fs
+
+        self.fs = fs if fs is not None else default_fs()
         self.shard_id = shard_id
         self.replica_id = replica_id
         self.compress_snapshots = compress_snapshots
@@ -186,17 +190,16 @@ class StateMachine:
                     self.sm.save_snapshot(ctx, w, lambda: False)
 
             tmp = path + ".generating"
-            with open(tmp, "wb") as f:
+            with self.fs.open(tmp, "wb") as f:
                 write_snapshot(f, session_data, write_payload,
                                compress=self.compress_snapshots)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+                self.fs.fsync(f)
+            self.fs.replace(tmp, path)
             return index, term, membership
 
     def recover_from_snapshot(self, path: str, ss: pb.Snapshot) -> None:
         with self._mu:
-            with open(path, "rb") as f:
+            with self.fs.open(path, "rb") as f:
                 session_data, payload = read_snapshot(f)
                 self.sessions = LRUSession.load(io.BytesIO(session_data))
                 if self.sm_type == pb.StateMachineType.ON_DISK:

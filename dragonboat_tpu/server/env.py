@@ -18,7 +18,6 @@ Parity with ``internal/server/environment.go``:
 
 from __future__ import annotations
 
-import fcntl
 import json
 import os
 import socket
@@ -61,7 +60,11 @@ class Env:
     """One NodeHost's view of its data directories."""
 
     def __init__(self, node_host_dir: str, raft_address: str,
-                 deployment_id: int = 0, wal_dir: str = "") -> None:
+                 deployment_id: int = 0, wal_dir: str = "",
+                 fs=None) -> None:
+        from dragonboat_tpu.vfs import default_fs
+
+        self.fs = fs if fs is not None else default_fs()
         self.raft_address = raft_address
         self.deployment_id = deployment_id
         self.hostname = socket.gethostname()
@@ -71,9 +74,9 @@ class Env:
         # (low-latency) volume; everything else stays under the root
         self.wal_root = (os.path.join(os.path.abspath(wal_dir), *suffix)
                          if wal_dir else self.root)
-        os.makedirs(self.root, exist_ok=True)
+        self.fs.makedirs(self.root)
         if self.wal_root != self.root:
-            os.makedirs(self.wal_root, exist_ok=True)
+            self.fs.makedirs(self.wal_root)
         self._lock_files: list = []
         self._nhid: str | None = None
 
@@ -82,7 +85,7 @@ class Env:
     @property
     def logdb_dir(self) -> str:
         d = os.path.join(self.wal_root, "logdb")
-        os.makedirs(d, exist_ok=True)
+        self.fs.makedirs(d)
         return d
 
     def snapshot_dir(self, shard_id: int, replica_id: int) -> str:
@@ -91,25 +94,24 @@ class Env:
             self.root, "snapshot",
             f"snapshot-{shard_id:016X}-{replica_id:016X}",
         )
-        os.makedirs(d, exist_ok=True)
+        self.fs.makedirs(d)
         return d
 
     def remove_snapshot_dir(self, shard_id: int, replica_id: int) -> None:
         """RemoveSnapshotDir (:304): tombstone then best-effort delete."""
         d = self.snapshot_dir(shard_id, replica_id)
-        with open(os.path.join(d, REMOVED_FLAG), "w") as f:
+        with self.fs.open(os.path.join(d, REMOVED_FLAG), "w") as f:
             f.write("removed\n")
-            f.flush()
-            os.fsync(f.fileno())
-        for fn in os.listdir(d):
+            self.fs.fsync(f)
+        for fn in self.fs.listdir(d):
             if fn != REMOVED_FLAG:
                 try:
-                    os.remove(os.path.join(d, fn))
+                    self.fs.remove(os.path.join(d, fn))
                 except OSError:
                     pass
 
     def snapshot_dir_removed(self, shard_id: int, replica_id: int) -> bool:
-        return os.path.exists(os.path.join(
+        return self.fs.exists(os.path.join(
             self.snapshot_dir(shard_id, replica_id), REMOVED_FLAG))
 
     # -- locking ----------------------------------------------------------
@@ -125,9 +127,9 @@ class Env:
             dirs.append(self.wal_root)
         for d in dirs:
             fp = os.path.join(d, LOCK_FILENAME)
-            f = open(fp, "a+")
+            f = self.fs.open(fp, "a+")
             try:
-                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self.fs.flock_exclusive(f)
             except OSError:
                 f.close()
                 self.close()
@@ -139,7 +141,7 @@ class Env:
     def close(self) -> None:
         for f in self._lock_files:
             try:
-                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                self.fs.flock_unlock(f)
             except OSError:
                 pass
             f.close()
@@ -168,16 +170,15 @@ class Env:
 
     def _check_dir(self, d: str, status: dict) -> None:
         fp = os.path.join(d, FLAG_FILENAME)
-        if not os.path.exists(fp):
+        if not self.fs.exists(fp):
             tmp = fp + ".tmp"
-            with open(tmp, "w") as f:
+            with self.fs.open(tmp, "w") as f:
                 json.dump(status, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, fp)
+                self.fs.fsync(f)
+            self.fs.replace(tmp, fp)
             return
-        with open(fp) as f:
-            saved = json.load(f)
+        with self.fs.open(fp, "r") as f:
+            saved = json.loads(f.read())
         if saved.get("address", "").strip().lower() != \
                 self.raft_address.strip().lower():
             raise NotOwnerError(
@@ -215,15 +216,14 @@ class Env:
         if self._nhid is not None:
             return self._nhid
         fp = os.path.join(self.root, NHID_FILENAME)
-        if os.path.exists(fp):
-            with open(fp) as f:
+        if self.fs.exists(fp):
+            with self.fs.open(fp, "r") as f:
                 self._nhid = f.read().strip()
         else:
             self._nhid = f"nhid-{uuid.uuid4()}"
             tmp = fp + ".tmp"
-            with open(tmp, "w") as f:
+            with self.fs.open(tmp, "w") as f:
                 f.write(self._nhid + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, fp)
+                self.fs.fsync(f)
+            self.fs.replace(tmp, fp)
         return self._nhid

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.config import NodeHostConfig
@@ -29,8 +28,11 @@ _LOG = get_logger("tools")
 META_SUFFIX = ".meta.json"
 
 
-def write_export_metadata(path: str, ss: pb.Snapshot) -> None:
+def write_export_metadata(path: str, ss: pb.Snapshot, fs=None) -> None:
     """Sidecar written next to an exported snapshot image."""
+    from dragonboat_tpu.vfs import default_fs
+
+    fs = fs if fs is not None else default_fs()
     meta = {
         "shard_id": ss.shard_id,
         "index": ss.index,
@@ -47,16 +49,18 @@ def write_export_metadata(path: str, ss: pb.Snapshot) -> None:
         },
     }
     tmp = path + META_SUFFIX + ".tmp"
-    with open(tmp, "w") as f:
+    with fs.open(tmp, "w") as f:
         json.dump(meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path + META_SUFFIX)
+        fs.fsync(f)
+    fs.replace(tmp, path + META_SUFFIX)
 
 
-def read_export_metadata(path: str) -> dict:
-    with open(path + META_SUFFIX) as f:
-        return json.load(f)
+def read_export_metadata(path: str, fs=None) -> dict:
+    from dragonboat_tpu.vfs import default_fs
+
+    fs = fs if fs is not None else default_fs()
+    with fs.open(path + META_SUFFIX, "r") as f:
+        return json.loads(f.read())
 
 
 def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
@@ -70,13 +74,17 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
     its own data dir before any of them restarts."""
     if replica_id not in members:
         raise ValueError(f"replica {replica_id} not in the new membership")
-    meta = read_export_metadata(src_path)
+    from dragonboat_tpu.vfs import default_fs
+
+    fs = (nhconfig.expert.fs if nhconfig.expert.fs is not None
+          else default_fs())
+    meta = read_export_metadata(src_path, fs=fs)
     membership = pb.Membership(
         config_change_id=meta["index"],
         addresses=dict(members),
     )
     env = Env(nhconfig.node_host_dir, nhconfig.raft_address,
-              nhconfig.deployment_id, wal_dir=nhconfig.wal_dir)
+              nhconfig.deployment_id, wal_dir=nhconfig.wal_dir, fs=fs)
     env.lock()
     try:
         env.check_node_host_dir("tan")
@@ -88,10 +96,13 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
             dst_dir,
             f"snapshot-{shard_id:016X}-{replica_id:016X}-{index:016X}"
             ".gbsnap")
-        shutil.copyfile(src_path, dst)
+        with fs.open(src_path, "rb") as sf, fs.open(dst, "wb") as df:
+            while chunk := sf.read(1 << 20):
+                df.write(chunk)
+            fs.fsync(df)
         ss = pb.Snapshot(
             filepath=dst,
-            file_size=os.path.getsize(dst),
+            file_size=fs.getsize(dst),
             index=index,
             term=int(meta["term"]),
             membership=membership,
@@ -102,7 +113,7 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
         # rebuild the replica's log-db state around the imported snapshot:
         # drop old state, stamp the snapshot + bootstrap (import.go main
         # flow: ssEnv.FinalizeSnapshot + logdb writes)
-        db = TanLogDB(env.logdb_dir)
+        db = TanLogDB(env.logdb_dir, fs=fs)
         try:
             db.import_snapshot(ss, replica_id)
         finally:

@@ -1,0 +1,213 @@
+"""vfs: MemFS power-loss simulation, ErrorFS fault injection, and the
+NodeHost's controlled-crash reaction to storage failures.
+
+Reference behaviors: internal/vfs/vfs.go (IFS / strict MemFS / ErrorFS),
+nodehost.go:361-367 (injected FS errors become controlled crashes),
+tan durability under injected faults.
+"""
+
+import time
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+from dragonboat_tpu.logdb.tan import TanLogDB
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.vfs import ErrorFS, InjectedError, MemFS
+
+from test_nodehost import KVStateMachine, wait_leader
+
+
+def _update(i, term=1):
+    return pb.Update(
+        shard_id=1, replica_id=1,
+        state=pb.State(term=term, vote=1, commit=i),
+        entries_to_save=(pb.Entry(term=term, index=i, cmd=b"x" * 8),),
+    )
+
+
+# -- MemFS ----------------------------------------------------------------
+
+
+def test_memfs_basics():
+    fs = MemFS()
+    fs.makedirs("/d")
+    with fs.open("/d/a.txt", "w") as f:
+        f.write("hello")
+    assert fs.exists("/d/a.txt")
+    assert fs.getsize("/d/a.txt") == 5
+    with fs.open("/d/a.txt", "r") as f:
+        assert f.read() == "hello"
+    fs.replace("/d/a.txt", "/d/b.txt")
+    assert not fs.exists("/d/a.txt")
+    assert fs.listdir("/d") == ["b.txt"]
+    with pytest.raises(FileNotFoundError):
+        fs.open("/d/missing", "rb")
+
+
+def test_memfs_crash_drops_unsynced():
+    fs = MemFS()
+    f = fs.open("/w.log", "ab")
+    f.write(b"synced")
+    fs.fsync(f)
+    f.write(b"-unsynced")
+    fs.crash()
+    with fs.open("/w.log", "rb") as r:
+        assert r.read() == b"synced"
+    # a file never synced disappears entirely
+    g = fs.open("/gone", "wb")
+    g.write(b"data")
+    fs.crash()
+    assert not fs.exists("/gone")
+
+
+def test_tan_on_memfs_crash_keeps_synced_records(tmp_path):
+    """tan on MemFS: save_raft_state fsyncs, so a crash() immediately
+    after loses nothing; unsynced appends are truncated as a torn tail."""
+    fs = MemFS()
+    db = TanLogDB(str(tmp_path), fs=fs)
+    for i in range(1, 11):
+        db.save_raft_state([_update(i)], worker_id=0)
+    # append a record but crash before the fsync: write bytes directly
+    db._append(1, 1, 1, b"\x01garbage-partial")
+    fs.crash()
+
+    db2 = TanLogDB(str(tmp_path), fs=fs)
+    ents = db2.iterate_entries(1, 1, 1, 11, 0)
+    assert [e.index for e in ents] == list(range(1, 11))
+    rs = db2.read_raft_state(1, 1, 0)
+    assert rs.state.commit == 10
+    db2.close()
+
+
+# -- ErrorFS --------------------------------------------------------------
+
+
+def test_errorfs_injects_on_fsync(tmp_path):
+    fs = ErrorFS.on_op(MemFS(), "fsync")
+    db = TanLogDB(str(tmp_path), fs=fs)
+    with pytest.raises(InjectedError):
+        db.save_raft_state([_update(1)], worker_id=0)
+
+
+def test_tan_survives_injected_write_failure(tmp_path):
+    """Writes that fail injection never ack; everything acked (fsynced)
+    before the fault is intact on reopen."""
+    base = MemFS()
+    fs = ErrorFS(base)
+    db = TanLogDB(str(tmp_path), fs=fs)
+    for i in range(1, 6):
+        db.save_raft_state([_update(i)], worker_id=0)
+    armed = {"on": False}
+    fs.inject = lambda op, path, a=armed: a["on"] and op in ("write", "fsync")
+    armed["on"] = True
+    with pytest.raises(InjectedError):
+        db.save_raft_state([_update(6)], worker_id=0)
+    armed["on"] = False
+    # power-loss on top of the fault: only fsynced state may survive
+    base.crash()
+    db2 = TanLogDB(str(tmp_path), fs=base)
+    ents = db2.iterate_entries(1, 1, 1, 100, 0)
+    assert [e.index for e in ents] == list(range(1, 6))
+    db2.close()
+
+
+# -- NodeHost integration -------------------------------------------------
+
+
+def _mem_cfg(addr, fs, base):
+    return NodeHostConfig(
+        raft_address=addr, rtt_millisecond=5, node_host_dir=base,
+        expert=ExpertConfig(fs=fs),
+    )
+
+
+def test_cluster_on_memfs_and_crash_recovery(tmp_path):
+    """A 3-replica cluster entirely on MemFS: zero disk IO; a simulated
+    power loss of every host preserves fsynced writes."""
+    fs = MemFS()
+    base = str(tmp_path)
+    addrs = {i: f"mem-{i}" for i in (1, 2, 3)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(_mem_cfg(addr, fs, base))
+        assert nh.logdb.name() == "tan"
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1))
+        hosts[rid] = nh
+    lead = wait_leader(hosts)
+    sess = hosts[lead].get_noop_session(1)
+    for i in range(10):
+        hosts[lead].sync_propose(sess, f"m{i}=v{i}".encode())
+    for h in hosts.values():
+        h.close()
+
+    fs.crash()  # power loss across the fleet
+
+    hosts2 = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(_mem_cfg(addr, fs, base))
+        nh.start_replica({}, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1))
+        hosts2[rid] = nh
+    try:
+        lead = wait_leader(hosts2)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                hosts2[lead].stale_read(1, "m9") is None:
+            time.sleep(0.05)
+        for i in range(10):
+            assert hosts2[lead].stale_read(1, f"m{i}") == f"v{i}", i
+        hosts2[lead].sync_propose(
+            hosts2[lead].get_noop_session(1), b"post=crash")
+        assert hosts2[lead].sync_read(1, "post") == "crash"
+    finally:
+        for h in hosts2.values():
+            h.close()
+
+
+def test_storage_fault_halts_nodehost(tmp_path):
+    """An injected log-write failure mid-flight is a controlled crash:
+    the host records fatal_error and stops stepping instead of acking
+    writes that never reached stable storage (nodehost.go:361-367)."""
+    base = MemFS()
+    fs = ErrorFS(base)
+    nh = NodeHost(_mem_cfg("flt-1", fs, str(tmp_path)))
+    nh.start_replica({1: "flt-1"}, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+    deadline = time.time() + 10
+    while time.time() < deadline and not nh.get_leader_id(1)[1]:
+        time.sleep(0.02)
+    sess = nh.get_noop_session(1)
+    nh.sync_propose(sess, b"ok=1")
+    armed = {"on": False}
+    fs.inject = lambda op, path, a=armed: (
+        a["on"] and op in ("write", "fsync") and ".tan" in path)
+    armed["on"] = True
+    with pytest.raises(Exception):
+        nh.sync_propose(sess, b"fails=1")
+    deadline = time.time() + 10
+    while time.time() < deadline and nh.fatal_error is None:
+        time.sleep(0.02)
+    assert isinstance(nh.fatal_error, InjectedError)
+    # fail fast: later requests must not ride the full timeout
+    t0 = time.time()
+    with pytest.raises(Exception):
+        nh.sync_propose(sess, b"again=1")
+    assert time.time() - t0 < 1.0
+    armed["on"] = False
+    nh.close()
+
+    # restart from the same (healthy again) FS: acked state is there
+    nh2 = NodeHost(_mem_cfg("flt-1", base, str(tmp_path)))
+    nh2.start_replica({}, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and nh2.stale_read(1, "ok") is None:
+            time.sleep(0.05)
+        assert nh2.stale_read(1, "ok") == "1"
+        assert nh2.fatal_error is None
+    finally:
+        nh2.close()
