@@ -80,6 +80,26 @@ def run_bench() -> None:
                       "/tmp/dragonboat_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    platform = jax.devices()[0].platform
+    groups = int(os.environ.get("BENCH_GROUPS", "8192"))
+    steps = int(os.environ.get("BENCH_STEPS", "200"))
+    # a TPU device error at one scale (watchdog on long launches, or a
+    # wedged tunnel mid-run) must not cost the whole record: retry the
+    # measurement at smaller G before giving up
+    last = None
+    for g in (groups, groups // 2, groups // 8):
+        if g < 64:
+            break
+        try:
+            return _measure(platform, g, steps)
+        except Exception:
+            import traceback
+
+            last = traceback.format_exc()
+    fail("run", last or "no config attempted")
+
+
+def _measure(platform: str, groups: int, steps: int) -> None:
     import numpy as np
 
     from dragonboat_tpu.bench_loop import (
@@ -90,9 +110,6 @@ def run_bench() -> None:
     )
     from dragonboat_tpu.core import params as KP
 
-    platform = jax.devices()[0].platform
-    groups = int(os.environ.get("BENCH_GROUPS", "8192"))
-    steps = int(os.environ.get("BENCH_STEPS", "200"))
     replicas = 3
     kp = bench_params(replicas)
 
@@ -103,8 +120,11 @@ def run_bench() -> None:
     assert lead.reshape(-1, replicas).any(axis=1).all()
 
     # warmup: compile exactly the loop variants the timed region will run
-    # (iters is a static jit arg — chunk and remainder sizes each compile)
-    chunk = max(1, int(os.environ.get("BENCH_CHUNK", "25")))
+    # (iters is a static jit arg — chunk and remainder sizes each compile).
+    # Default chunk scales inversely with G to keep every device launch
+    # well under the ~60 s TPU watchdog
+    default_chunk = max(2, min(25, (25 * 1024) // max(groups, 1)))
+    chunk = max(1, int(os.environ.get("BENCH_CHUNK", str(default_chunk))))
     t_compile = time.time()
     state, box = run_steps(kp, replicas, min(chunk, steps), True, True,
                            state, box)

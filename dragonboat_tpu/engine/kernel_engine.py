@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.tracing import annotate
 from dragonboat_tpu.config import Config
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.kernel import step as kernel_step
@@ -234,6 +235,12 @@ class KernelEngine:
         self._inbox_buf = _InboxBuilder(capacity, kp.inbox_cap,
                                         kp.msg_entries)
         self._input_buf = _InputBuilder(capacity, kp.proposal_cap)
+        # step-latency accounting + opt-in jax.profiler capture
+        from dragonboat_tpu.tracing import StepTimer, maybe_start_from_env
+
+        self._step_timer = StepTimer(self.events.metrics,
+                                     "engine.kernel_step")
+        maybe_start_from_env()
 
     # -- lane lifecycle ---------------------------------------------------
 
@@ -407,10 +414,14 @@ class KernelEngine:
             if not had_work:
                 return False
 
-            state, out = kernel_step(
-                self.kp, self.state, inbox.to_device(), inp.to_device())
-            self.state = state
-            self._process_outputs(nodes, out)
+            with self._step_timer.measure():
+                with annotate("kernel_engine.step"):
+                    state, out = kernel_step(
+                        self.kp, self.state, inbox.to_device(),
+                        inp.to_device())
+                with annotate("kernel_engine.process_outputs"):
+                    self.state = state
+                    self._process_outputs(nodes, out)
             return True
 
     # -- staging ----------------------------------------------------------

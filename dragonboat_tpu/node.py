@@ -119,6 +119,9 @@ class Node:
         # NotifyCommit (nodehost.go:1656): fire committed_event on commit,
         # before apply — set by NodeHost from NodeHostConfig
         self.notify_commit = False
+        # set by NodeHost for on-disk SMs: stream a live snapshot image
+        # to the peer instead of sending the recorded file
+        self.stream_snapshot_cb = None
 
         self.peer: Peer | None = None
         self.stopped = False
@@ -471,6 +474,14 @@ class Node:
     def _send(self, m: pb.Message) -> None:
         if m.to == self.replica_id:
             self.handle_message(m)
+            return
+        # on-disk SMs stream a LIVE image to lagging peers instead of
+        # shipping the recorded snapshot file (nodehost.go:1888-1891 →
+        # rsm.ChunkWriter; wired by NodeHost._stream_snapshot)
+        if (m.type == pb.MessageType.INSTALL_SNAPSHOT
+                and self.stream_snapshot_cb is not None
+                and self.sm.sm_type == pb.StateMachineType.ON_DISK):
+            self.stream_snapshot_cb(self, m)
             return
         self.send_message(m)
 

@@ -162,6 +162,8 @@ class NodeHost:
         # the host stops accepting work and records the fault for the
         # operator; restart from disk is the recovery path
         self.fatal_error: Exception | None = None
+        # monkey-test partition flag (monkey.go:170 PartitionNode)
+        self._partitioned = False
         self._work = threading.Event()
         self._engine_thread: threading.Thread | None = None
         self._tick_interval = nhconfig.rtt_millisecond / 1000.0
@@ -262,6 +264,7 @@ class NodeHost:
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
+            node.stream_snapshot_cb = self._stream_snapshot
             node.notify_commit = self.config.notify_commit
             members = initial_members if not join else {}
             node.start(members, initial=not join, new_node=new_node)
@@ -375,6 +378,7 @@ class NodeHost:
                     knode.snapshot_dir, events=self.events, fs=self.fs)
         node.membership_changed_cb = (
             lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc))
+        node.stream_snapshot_cb = self._stream_snapshot
         # transplant the books so callers' futures survive the move
         for attr in ("pending_proposals", "pending_reads",
                      "pending_config_change", "pending_snapshot",
@@ -515,9 +519,100 @@ class NodeHost:
         for n in nodes:
             n.tick()
 
+    def _stream_snapshot(self, node: Node, m: pb.Message) -> None:
+        """Live-stream an on-disk SM's snapshot to a lagging peer
+        (nodehost.go:1888-1891 → rsm.ChunkWriter + transport job.go):
+        the image is produced by the SM directly into transport chunks —
+        no sender-side file.  Runs as a background job so a large stream
+        never stalls the step workers."""
+        import queue as _queue
+
+        from dragonboat_tpu.rsm.chunkwriter import ChunkWriter
+
+        class _Aborted(Exception):
+            pass
+
+        def job() -> None:
+            q: _queue.Queue = _queue.Queue(maxsize=8)
+            DONE, FAIL = object(), object()
+            aborted = threading.Event()
+
+            def emit(c) -> None:
+                # never block forever: if the consumer abandoned the
+                # stream (breaker open, send error), the producer must
+                # unwind instead of deadlocking inside the SM lock
+                while not aborted.is_set():
+                    try:
+                        q.put(c, timeout=0.2)
+                        return
+                    except _queue.Full:
+                        continue
+                raise _Aborted()
+
+            cw = ChunkWriter(
+                emit, shard_id=node.shard_id, to_replica=m.to,
+                from_=node.replica_id,
+                deployment_id=self.config.deployment_id,
+                source_address=self.config.raft_address,
+            )
+
+            def on_meta(index, term, membership):
+                from dataclasses import replace
+
+                cw.index, cw.term = index, term
+                cw.message = replace(m, snapshot=pb.Snapshot(
+                    index=index, term=term, membership=membership,
+                    shard_id=node.shard_id, type=node.sm.sm_type,
+                    on_disk_index=index,
+                ))
+
+            def producer() -> None:
+                try:
+                    node.sm.stream_snapshot(cw, on_meta=on_meta)
+                    cw.close()
+                    q.put(DONE)
+                except _Aborted:
+                    pass  # consumer gone; nothing to report
+                except Exception:
+                    _LOG.exception("snapshot stream save failed")
+                    try:
+                        q.put(FAIL, timeout=0.2)
+                    except _queue.Full:
+                        pass
+
+            t = threading.Thread(target=producer, name="snapshot-save-stream",
+                                 daemon=True)
+            t.start()
+
+            def chunks():
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        return
+                    if item is FAIL:
+                        raise RuntimeError("stream producer failed")
+                    yield item
+
+            try:
+                self.hub.send_snapshot_chunks(m, chunks())
+            finally:
+                # unwind the producer whether or not the send completed
+                aborted.set()
+                while t.is_alive():
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        pass
+                    t.join(timeout=0.05)
+
+        threading.Thread(target=job, name="snapshot-stream-job",
+                         daemon=True).start()
+
     # -- transport glue --------------------------------------------------
 
     def _send_message(self, m: pb.Message) -> None:
+        if self._partitioned:
+            return  # monkey partition: silence sends (nodehost.go:1877)
         self.hub.send(m)
         self._work.set()
 
@@ -526,6 +621,8 @@ class NodeHost:
         nodehost.go:2072)."""
         if batch.deployment_id != self.config.deployment_id:
             return  # transport.go:306-311 deployment-id gate
+        if self._partitioned:
+            return  # monkey partition: silence receive (nodehost.go:2076)
         # learn the sender's address so responses resolve even before any
         # membership entry applies locally (transport.go:317-324).  Not in
         # gossip mode: targets there are NodeHostIDs, and pinning a raw
@@ -815,3 +912,40 @@ class NodeHost:
         """Counter snapshot (the reference's Prometheus surface); the
         transport hub shares the same registry under ``transport.*``."""
         return self.events.metrics.snapshot()
+
+    # -- chaos-test surface (monkey.go, build tag dragonboat_monkeytest) --
+
+    def partition_node(self) -> None:
+        """Silence this host's sends AND receives (monkey.go:170
+        PartitionNode): the cluster sees a dead machine while local
+        clients keep timing out against it."""
+        self._partitioned = True
+        t = self.transport
+        if hasattr(t, "partitioned"):
+            t.partitioned = True
+
+    def restore_partitioned_node(self) -> None:
+        """monkey.go:178 RestorePartitionedNode."""
+        self._partitioned = False
+        t = self.transport
+        if hasattr(t, "partitioned"):
+            t.partitioned = False
+        self._work.set()
+
+    def get_session_hash(self, shard_id: int) -> int:
+        """Convergence oracle over the session book (monkey.go:117)."""
+        return self._node(shard_id).sm.get_session_hash()
+
+    def get_membership_hash(self, shard_id: int) -> int:
+        """Convergence oracle over membership (monkey.go:118)."""
+        return self._node(shard_id).sm.get_membership_hash()
+
+    def get_sm_hash(self, shard_id: int) -> int:
+        """User-SM convergence oracle (monkey.go:114 GetStateMachineHash);
+        the user SM must expose ``get_hash() -> int``."""
+        sm = self._node(shard_id).sm.sm
+        get_hash = getattr(sm, "get_hash", None)
+        if get_hash is None:
+            raise RequestError(
+                "state machine does not implement get_hash()")
+        return int(get_hash())

@@ -171,24 +171,36 @@ class StateMachine:
 
     # -- snapshot save/recover (statemachine.go:553/246) -------------------
 
-    def save_snapshot(self, path: str) -> tuple[int, int, pb.Membership]:
-        with self._mu:
-            index, term = self.last_applied, self.last_applied_term
-            membership = self.members.get()
-            sbuf = io.BytesIO()
-            self.sessions.save(sbuf)
-            session_data = sbuf.getvalue()
+    def _prepare_save(self):
+        """Under the apply lock: meta + session image + the payload writer
+        (ctx captured for concurrent/on-disk SMs so the payload itself can
+        be produced OUTSIDE the lock — statemachine.go:553 Prepare under
+        mu, save concurrent)."""
+        index, term = self.last_applied, self.last_applied_term
+        membership = self.members.get()
+        sbuf = io.BytesIO()
+        self.sessions.save(sbuf)
+        session_data = sbuf.getvalue()
+        if self.sm_type == pb.StateMachineType.REGULAR:
+            def write_payload(w):
+                self.sm.save_snapshot(w, _FileCollection(), lambda: False)
+        elif self.sm_type == pb.StateMachineType.CONCURRENT:
+            ctx = self.sm.prepare_snapshot()
 
             def write_payload(w):
-                if self.sm_type == pb.StateMachineType.REGULAR:
-                    self.sm.save_snapshot(w, _FileCollection(), lambda: False)
-                elif self.sm_type == pb.StateMachineType.CONCURRENT:
-                    ctx = self.sm.prepare_snapshot()
-                    self.sm.save_snapshot(ctx, w, _FileCollection(), lambda: False)
-                else:
-                    ctx = self.sm.prepare_snapshot()
-                    self.sm.save_snapshot(ctx, w, lambda: False)
+                self.sm.save_snapshot(ctx, w, _FileCollection(),
+                                      lambda: False)
+        else:
+            ctx = self.sm.prepare_snapshot()
 
+            def write_payload(w):
+                self.sm.save_snapshot(ctx, w, lambda: False)
+        return index, term, membership, session_data, write_payload
+
+    def save_snapshot(self, path: str) -> tuple[int, int, pb.Membership]:
+        with self._mu:
+            index, term, membership, session_data, write_payload = \
+                self._prepare_save()
             tmp = path + ".generating"
             with self.fs.open(tmp, "wb") as f:
                 write_snapshot(f, session_data, write_payload,
@@ -196,6 +208,30 @@ class StateMachine:
                 self.fs.fsync(f)
             self.fs.replace(tmp, path)
             return index, term, membership
+
+    def stream_snapshot(self, w, on_meta=None) -> tuple[int, int, "pb.Membership"]:
+        """Streaming save (statemachine.go:568 Stream): write the same
+        container ``save_snapshot`` produces into ``w`` (a ChunkWriter),
+        without any local file.  ``on_meta(index, term, membership)`` is
+        called under the apply lock BEFORE payload bytes are written.
+
+        Only prepare runs under the apply lock; the payload is produced
+        outside it (concurrent/on-disk SMs snapshot a prepared ctx), so a
+        slow or paced network transfer never blocks applies.  REGULAR SMs
+        have no prepared-ctx contract and keep the lock for the write —
+        the reference only streams on-disk SMs at all."""
+        with self._mu:
+            index, term, membership, session_data, write_payload = \
+                self._prepare_save()
+            if on_meta is not None:
+                on_meta(index, term, membership)
+            if self.sm_type == pb.StateMachineType.REGULAR:
+                write_snapshot(w, session_data, write_payload,
+                               compress=self.compress_snapshots)
+                return index, term, membership
+        write_snapshot(w, session_data, write_payload,
+                       compress=self.compress_snapshots)
+        return index, term, membership
 
     def recover_from_snapshot(self, path: str, ss: pb.Snapshot) -> None:
         with self._mu:

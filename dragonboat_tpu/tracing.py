@@ -1,0 +1,98 @@
+"""Tracing / profiling hooks.
+
+The reference has no tracer (SURVEY §5 flags this as a gap to fill, not a
+port): its observability is module loggers + Prometheus counters.  The
+TPU build adds a real trace path on top of the same metrics registry:
+
+- ``start_trace(dir)`` / ``stop_trace()`` — JAX profiler capture (XLA
+  device traces, host Python, HLO cost attribution) viewable in
+  TensorBoard / Perfetto;
+- ``annotate(name)`` — named span visible inside the device trace
+  (``jax.profiler.TraceAnnotation``), used around the kernel engine's
+  step phases;
+- ``StepTimer`` — lightweight EWMA + max step-latency accounting that
+  feeds the shared metrics registry (``engine.step_us_*`` counters), on
+  all the time (the profiler itself is opt-in: capture costs memory).
+
+Environment: ``DRAGONBOAT_TPU_TRACE_DIR`` arms profiler capture at import
+of the engine, for drive-by profiling without code changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+_active_trace_dir: str | None = None
+
+
+def start_trace(trace_dir: str) -> None:
+    """Begin a JAX profiler capture into ``trace_dir``."""
+    global _active_trace_dir
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    _active_trace_dir = trace_dir
+
+
+def stop_trace() -> str | None:
+    """End the capture; returns the trace dir (None if none active)."""
+    global _active_trace_dir
+    if _active_trace_dir is None:
+        return None
+    import jax
+
+    jax.profiler.stop_trace()
+    d, _active_trace_dir = _active_trace_dir, None
+    return d
+
+
+def maybe_start_from_env() -> bool:
+    """Arm capture when DRAGONBOAT_TPU_TRACE_DIR is set (idempotent)."""
+    d = os.environ.get("DRAGONBOAT_TPU_TRACE_DIR")
+    if d and _active_trace_dir is None:
+        start_trace(d)
+        return True
+    return False
+
+
+def annotate(name: str):
+    """Named span in the device trace; near-zero cost when no capture is
+    active (a module-flag check, no jax import or span object)."""
+    if _active_trace_dir is None:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class StepTimer:
+    """Step-latency accounting into a Metrics registry.
+
+    Keeps an exponentially-weighted mean and the max in integer
+    microseconds so the snapshot stays a plain counter dict."""
+
+    def __init__(self, metrics, prefix: str) -> None:
+        self.metrics = metrics
+        self.prefix = prefix
+        self._ewma_us = 0.0
+
+    @contextlib.contextmanager
+    def measure(self):
+        t0 = time.perf_counter()
+        yield
+        us = (time.perf_counter() - t0) * 1e6
+        self._ewma_us = us if self._ewma_us == 0 else (
+            0.9 * self._ewma_us + 0.1 * us)
+        m = self.metrics
+        m.inc(f"{self.prefix}.steps")
+        m.inc(f"{self.prefix}.total_us", int(us))
+        with m.mu:
+            key = f"{self.prefix}.ewma_us"
+            m.counters[key] = int(self._ewma_us)
+            key = f"{self.prefix}.max_us"
+            m.counters[key] = max(m.counters.get(key, 0), int(us))

@@ -73,8 +73,11 @@ class ChanTransport(ITransport):
         self.chunk_handler = chunk_handler
         self.running = False
         self.partitioned = False  # monkey-test hook (monkey.go:170)
-        # test hooks: drop predicate (monkey transport drop hooks :83-89)
+        # test hooks (monkey transport hooks :83-89): drop predicate,
+        # per-message delay (seconds), and seeded in-batch reordering
         self.drop_predicate: Callable[[pb.Message], bool] | None = None
+        self.delay_func: Callable[[pb.Message], float] | None = None
+        self.reorder_rng = None  # random.Random; shuffles batch requests
 
     def name(self) -> str:
         return "chan-transport"
@@ -96,14 +99,26 @@ class ChanTransport(ITransport):
     def deliver(self, batch: pb.MessageBatch) -> None:
         if self.partitioned:
             return
+        reqs = batch.requests
         if self.drop_predicate is not None:
-            reqs = tuple(m for m in batch.requests if not self.drop_predicate(m))
+            reqs = tuple(m for m in reqs if not self.drop_predicate(m))
+        if self.reorder_rng is not None and len(reqs) > 1:
+            shuffled = list(reqs)
+            self.reorder_rng.shuffle(shuffled)
+            reqs = tuple(shuffled)
+        if reqs is not batch.requests:
             batch = pb.MessageBatch(
                 requests=reqs,
                 deployment_id=batch.deployment_id,
                 source_address=batch.source_address,
                 bin_ver=batch.bin_ver,
             )
+        if self.delay_func is not None:
+            delays = [self.delay_func(m) for m in batch.requests]
+            d = max(delays, default=0.0)
+            if d > 0:
+                threading.Timer(d, self.message_handler, (batch,)).start()
+                return
         self.message_handler(batch)
 
     def deliver_chunk(self, chunk: dict) -> None:

@@ -122,6 +122,8 @@ class ChunkSink:
             t.fh.write(c.data)
             t.validated += len(c.data)
             t.next_chunk = c.chunk_id + 1
+            # streamed transfers (chunkwriter.py) carry chunk_count=0 until
+            # the tail chunk, whose count/file_size close the transfer
             if c.is_last():
                 t.fh.close()
                 if c.file_size and t.validated != c.file_size:

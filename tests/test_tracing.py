@@ -1,0 +1,23 @@
+"""Tracing hooks: step-latency accounting and profiler span no-ops."""
+
+from dragonboat_tpu.events import Metrics
+from dragonboat_tpu.tracing import StepTimer, annotate
+
+
+def test_step_timer_feeds_metrics():
+    m = Metrics()
+    t = StepTimer(m, "engine.test")
+    for _ in range(3):
+        with t.measure():
+            pass
+    snap = m.snapshot()
+    assert snap["engine.test.steps"] == 3
+    assert snap["engine.test.total_us"] >= 0
+    assert "engine.test.ewma_us" in snap
+    assert snap["engine.test.max_us"] >= snap["engine.test.ewma_us"] // 2
+
+
+def test_annotate_is_safe_without_capture():
+    with annotate("noop-span"):
+        x = 1 + 1
+    assert x == 2
