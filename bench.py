@@ -87,9 +87,10 @@ def run_bench() -> None:
     # wedged tunnel mid-run) must not cost the whole record: retry the
     # measurement at smaller G before giving up
     last = None
-    for g in (groups, groups // 2, groups // 8):
-        if g < 64:
-            break
+    # always attempt the configured scale; only the fallback scales are
+    # floored at 64 groups
+    ladder = [groups] + [g for g in (groups // 2, groups // 8) if g >= 64]
+    for g in ladder:
         try:
             return _measure(platform, g, steps)
         except Exception:

@@ -180,27 +180,24 @@ class TanLogDB(ILogDB):
             self._open_active(files[-1])
 
     def _replay_file(self, fileno: int, truncate_tail: bool) -> None:
+        """Single-pass scan + validate of a whole log file — the frame walk
+        runs in C when available (native/dbtpu_native.c dbtpu_tan_scan),
+        the record decode stays in Python (it builds the index)."""
+        from dragonboat_tpu import native
+
         path = self._path(fileno)
-        size = self.fs.getsize(path)
         with self.fs.open(path, "rb") as f:
-            off = 0
-            while off + _HDR.size <= size:
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    break
-                magic, ln, crc = _HDR.unpack(hdr)
-                payload = f.read(ln)
-                torn = (magic != MAGIC or len(payload) < ln
-                        or zlib.crc32(payload) != crc)
-                if torn:
-                    if truncate_tail:
-                        with self.fs.open(path, "r+b") as tf:
-                            tf.truncate(off)
-                        return
-                    raise CorruptLogError(
-                        f"{path}@{off}: bad record in non-tail log file")
-                self._apply_record(fileno, off, payload)
-                off += _HDR.size + ln
+            buf = f.read()
+        recs, scan_end, torn = native.tan_scan(buf, MAGIC)
+        for off, poff, plen in recs:
+            self._apply_record(fileno, off, buf[poff:poff + plen])
+        if torn:
+            if truncate_tail:
+                with self.fs.open(path, "r+b") as tf:
+                    tf.truncate(scan_end)
+                return
+            raise CorruptLogError(
+                f"{path}@{scan_end}: bad record in non-tail log file")
 
     def _apply_record(self, fileno: int, off: int, payload: bytes) -> None:
         rectype, shard_id, replica_id = _KEY.unpack_from(payload, 0)

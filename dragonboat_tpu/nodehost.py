@@ -575,10 +575,15 @@ class NodeHost:
                     pass  # consumer gone; nothing to report
                 except Exception:
                     _LOG.exception("snapshot stream save failed")
-                    try:
-                        q.put(FAIL, timeout=0.2)
-                    except _queue.Full:
-                        pass
+                    # deliver FAIL with the same patience as emit: the
+                    # consumer may be paced; dropping it would leave the
+                    # consumer blocked in q.get() forever
+                    while not aborted.is_set():
+                        try:
+                            q.put(FAIL, timeout=0.2)
+                            break
+                        except _queue.Full:
+                            continue
 
             t = threading.Thread(target=producer, name="snapshot-save-stream",
                                  daemon=True)

@@ -49,10 +49,15 @@ def stop_trace() -> str | None:
 
 
 def maybe_start_from_env() -> bool:
-    """Arm capture when DRAGONBOAT_TPU_TRACE_DIR is set (idempotent)."""
+    """Arm capture when DRAGONBOAT_TPU_TRACE_DIR is set (idempotent).
+    JAX only serializes the capture on stop, so an env-armed trace
+    registers an atexit stop — otherwise the dir would stay empty."""
     d = os.environ.get("DRAGONBOAT_TPU_TRACE_DIR")
     if d and _active_trace_dir is None:
+        import atexit
+
         start_trace(d)
+        atexit.register(stop_trace)
         return True
     return False
 

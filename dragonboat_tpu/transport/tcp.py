@@ -17,6 +17,8 @@ import struct
 import threading
 import zlib
 
+from dragonboat_tpu import native as _native
+
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
 
@@ -220,7 +222,7 @@ class TCPTransport(ITransport):
                 raw = _recv_exact(sock, _REQ_HDR.size)
                 method, size, pcrc = _decode_header(raw)
                 payload = _recv_exact(sock, size)
-                if zlib.crc32(payload) != pcrc:
+                if not _native.frame_check(payload, pcrc):
                     raise ValueError("payload crc mismatch")
                 if method == RAFT_TYPE:
                     self.message_handler(pb.decode_message_batch(payload))

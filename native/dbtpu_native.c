@@ -1,0 +1,85 @@
+/* dbtpu_native — C runtime primitives for the dragonboat_tpu host path.
+ *
+ * The reference's runtime is compiled Go; the TPU build keeps JAX/XLA for
+ * the compute path and implements the host runtime's hot loops natively
+ * where Python-level looping is the bottleneck:
+ *
+ *  - tan log replay (logdb/tan.py _replay_file): one pass over a whole
+ *    log file validating [magic | len | crc32(payload)] frames — the
+ *    startup-recovery hot loop over potentially GBs of WAL
+ *    (reference: internal/tan/db.go replay + record.go checksums);
+ *  - TCP frame validation (transport/tcp.py): header+payload CRC checks
+ *    (reference: internal/transport/tcp.go requestHeader).
+ *
+ * Plain C + ctypes (no CPython API): the Python side passes raw buffers;
+ * crc32 comes from zlib, matching Python's zlib.crc32 bit-for-bit.
+ *
+ * Build: cc -O2 -shared -fPIC dbtpu_native.c -lz -o dbtpu_native.so
+ * (driven by dragonboat_tpu/native.py on first import, cached).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+#include <zlib.h>
+
+/* one framed record: [u32 magic][u32 len][u32 crc][payload len bytes] */
+typedef struct {
+    uint64_t offset;        /* of the frame start */
+    uint64_t payload_off;   /* of the payload within buf */
+    uint32_t payload_len;
+} dbtpu_rec;
+
+/* Scan an entire log image, validating every frame.
+ *
+ * Returns the number of valid records written to out (capped at max_out).
+ * *scan_end receives the offset one past the last valid frame.
+ * *status: 0 = clean EOF, 1 = torn/corrupt frame at *scan_end,
+ *          2 = out table full (call again with a larger table).     */
+int dbtpu_tan_scan(const uint8_t *buf, uint64_t len, uint32_t magic,
+                   dbtpu_rec *out, uint64_t max_out,
+                   uint64_t *n_out, uint64_t *scan_end, int *status)
+{
+    uint64_t off = 0, n = 0;
+    while (off + 12 <= len) {
+        uint32_t m, plen, crc;
+        memcpy(&m, buf + off, 4);
+        memcpy(&plen, buf + off + 4, 4);
+        memcpy(&crc, buf + off + 8, 4);
+        if (m != magic || off + 12 + (uint64_t)plen > len) {
+            *n_out = n; *scan_end = off; *status = 1;
+            return 0;
+        }
+        uint32_t actual = (uint32_t)crc32(0L, buf + off + 12, plen);
+        if (actual != crc) {
+            *n_out = n; *scan_end = off; *status = 1;
+            return 0;
+        }
+        if (n >= max_out) {
+            *n_out = n; *scan_end = off; *status = 2;
+            return 0;
+        }
+        out[n].offset = off;
+        out[n].payload_off = off + 12;
+        out[n].payload_len = plen;
+        n++;
+        off += 12 + plen;
+    }
+    *n_out = n;
+    *scan_end = off;
+    *status = (off == len) ? 0 : 1;  /* trailing partial header = torn */
+    return 0;
+}
+
+/* Validate one framed TCP request: header CRC over the payload.
+ * Returns 1 valid / 0 invalid. */
+int dbtpu_frame_check(const uint8_t *payload, uint64_t len, uint32_t crc)
+{
+    return (uint32_t)crc32(0L, payload, len) == crc;
+}
+
+/* crc32 passthrough (zlib polynomial), for parity tests */
+uint32_t dbtpu_crc32(const uint8_t *buf, uint64_t len, uint32_t seed)
+{
+    return (uint32_t)crc32(seed, buf, len);
+}

@@ -1,0 +1,155 @@
+"""Linearizability: history recording, the built-in register checker,
+and an E2E chaos run with concurrent clients across a partition.
+
+Reference behavior: docs/test.md — client histories recorded under
+monkey tests and checked with Knossos/porcupine; the built-in checker
+plays porcupine's role for test-sized histories.
+"""
+
+import json
+import threading
+import time
+
+from dragonboat_tpu.history import HistoryRecorder, Op, check_linearizable_kv
+
+from test_monkey import _mk
+from test_nodehost import wait_leader
+
+
+def _op(process, op, key, value, call, ret, ok=True):
+    return Op(process=process, op=op, key=key, value=value, call=call,
+              ret=ret, ok=ok)
+
+
+def test_checker_accepts_sequential_history():
+    ops = [
+        _op(1, "write", "k", "a", 0.0, 1.0),
+        _op(2, "read", "k", "a", 2.0, 3.0),
+        _op(1, "write", "k", "b", 4.0, 5.0),
+        _op(2, "read", "k", "b", 6.0, 7.0),
+    ]
+    assert check_linearizable_kv(ops)
+
+
+def test_checker_rejects_stale_read():
+    ops = [
+        _op(1, "write", "k", "a", 0.0, 1.0),
+        _op(1, "write", "k", "b", 2.0, 3.0),
+        # reads AFTER write b completed must not see a
+        _op(2, "read", "k", "a", 4.0, 5.0),
+    ]
+    assert not check_linearizable_kv(ops)
+
+
+def test_checker_allows_concurrent_read_either_value():
+    ops = [
+        _op(1, "write", "k", "a", 0.0, 1.0),
+        _op(1, "write", "k", "b", 2.0, 6.0),
+        _op(2, "read", "k", "a", 3.0, 4.0),   # concurrent with write b
+        _op(3, "read", "k", "b", 3.5, 5.0),   # also fine: b linearized first
+    ]
+    assert check_linearizable_kv(ops)
+    # but once a read saw b, a LATER read may not see a again
+    bad = ops + [_op(2, "read", "k", "a", 5.5, 7.0)]
+    assert not check_linearizable_kv(bad)
+
+
+def test_checker_open_write_may_or_may_not_apply():
+    ops = [
+        _op(1, "write", "k", "a", 0.0, 1.0),
+        _op(1, "write", "k", "b", 2.0, None),  # timed out: unknown
+        _op(2, "read", "k", "a", 3.0, 4.0),    # ok if b never applied
+    ]
+    assert check_linearizable_kv(ops)
+    ops2 = [
+        _op(1, "write", "k", "a", 0.0, 1.0),
+        _op(1, "write", "k", "b", 2.0, None),
+        _op(2, "read", "k", "b", 3.0, 4.0),    # ok if b DID apply
+    ]
+    assert check_linearizable_kv(ops2)
+
+
+def test_export_jsonl(tmp_path):
+    h = HistoryRecorder()
+    r = h.invoke(1, "write", "k", "v1")
+    h.complete(r)
+    r2 = h.invoke(2, "read", "k")
+    h.complete(r2, value="v1")
+    r3 = h.invoke(3, "write", "k", "v2")  # left open (timeout)
+    path = str(tmp_path / "history.jsonl")
+    h.export_jsonl(path)
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 3
+    assert lines[0] == {"process": 1, "op": "write", "key": "k",
+                        "value": "v1", "call": lines[0]["call"],
+                        "return": lines[0]["return"], "ok": True}
+    assert lines[2]["return"] is None and lines[2]["ok"] is None
+    assert r3.ret is None
+
+
+def test_e2e_history_linearizable_across_partition():
+    """Concurrent writers+readers against a 3-replica cluster while the
+    leader is partitioned away mid-run; the recorded history must be
+    linearizable (the monkey harness's core assertion, docs/test.md)."""
+    hosts = _mk(f"hl{time.monotonic_ns()}")
+    h = HistoryRecorder()
+    stop = threading.Event()
+
+    def client(pid: int) -> None:
+        seq = 0
+        while not stop.is_set():
+            lid = None
+            for rid, nh in hosts.items():
+                got, ok = nh.get_leader_id(1)
+                if ok and got in hosts:
+                    lid = got
+                    break
+            if lid is None:
+                time.sleep(0.02)
+                continue
+            nh = hosts[lid]
+            try:
+                if pid % 2 == 0:
+                    val = f"p{pid}s{seq}"
+                    seq += 1
+                    rec = h.invoke(pid, "write", "x", val)
+                    try:
+                        nh.sync_propose(nh.get_noop_session(1),
+                                        f"x={val}".encode(), timeout_s=1.0)
+                        h.complete(rec)
+                    except Exception:
+                        pass  # open: outcome unknown
+                else:
+                    rec = h.invoke(pid, "read", "x")
+                    try:
+                        v = nh.sync_read(1, "x", timeout_s=1.0)
+                        h.complete(rec, value=v)
+                    except Exception:
+                        pass
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(p,), daemon=True)
+               for p in range(4)]
+    try:
+        wait_leader(hosts)
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        lid = wait_leader(hosts)
+        hosts[lid].partition_node()   # chaos mid-run
+        time.sleep(1.5)
+        hosts[lid].restore_partitioned_node()
+        time.sleep(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        for nh in hosts.values():
+            nh.close()
+
+    completed = [o for o in h.ops if o.ret is not None]
+    assert len(completed) >= 10, "history too thin to mean anything"
+    assert check_linearizable_kv(h.ops, initial=None), \
+        "linearizability violation in recorded history"
