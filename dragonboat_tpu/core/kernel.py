@@ -394,10 +394,33 @@ def _ri_confirm(kp, s: ShardState, eff: Effects, mask, low, high, sender_slot):
 # ---------------------------------------------------------------------------
 
 
-def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
-    """One inbound message against one shard — masked analog of
-    raft.Handle (raft.go:1596) for the kernel-resident message set."""
-    E = kp.msg_entries
+class _Pre(NamedTuple):
+    """Shared term/role preamble results for one inbound message."""
+
+    act: jnp.ndarray
+    is_leader: jnp.ndarray
+    is_candidate: jnp.ndarray
+    is_follower_like: jnp.ndarray
+    sender_known: jnp.ndarray
+    sender_slot: jnp.ndarray
+    noop_reply: jnp.ndarray
+
+
+class _Resp(NamedTuple):
+    r_type: jnp.ndarray
+    r_to: jnp.ndarray
+    r_term: jnp.ndarray
+    r_log_index: jnp.ndarray
+    r_reject: jnp.ndarray
+    r_hint: jnp.ndarray
+    r_hint_high: jnp.ndarray
+
+
+def _preamble(kp: P.KernelParams, s: ShardState, m):
+    """Term preamble + role folding shared by every handler family —
+    raft.go:1540 onMessageTermNotMatched + the candidate fold
+    (raft.go:2218).  Returns the updated state and the masks handlers
+    key on."""
     valid = m.from_ != 0
     mtype = m.mtype
 
@@ -413,7 +436,6 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
         | (mtype == MT.READ_INDEX_RESP)
     )
 
-    # ---- term preamble (raft.go:1540 onMessageTermNotMatched) ----
     drop_rv = (
         valid & is_rv_msg & s.check_quorum & (m.term > s.term)
         & (m.hint != m.from_)
@@ -437,32 +459,46 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     ignore = drop_rv | lower
 
     act = valid & ~ignore
-    is_leader = s.role == P.LEADER
     is_candidate = (s.role == P.CANDIDATE) | (s.role == P.PRE_VOTE_CANDIDATE)
     is_follower_like = (
         (s.role == P.FOLLOWER) | (s.role == P.NON_VOTING) | (s.role == P.WITNESS)
     )
 
-    # candidate + same-term leader message → become follower (raft.go:2218)
-    cand_fold = act & is_candidate & is_leader_msg & (
+    # candidate + same-term leader message -> become follower (raft.go:2218)
+    cand_fold = act & is_candidate & (
         (mtype == MT.REPLICATE) | (mtype == MT.HEARTBEAT)
     )
     s = _become_follower(s, cand_fold, s.term, m.from_)
     is_follower_like = is_follower_like | cand_fold
 
-    # response accumulator for this message
-    r_type = jnp.asarray(0, I32)
-    r_to = m.from_
-    r_term = s.term
-    r_log_index = jnp.asarray(0, I32)
-    r_reject = jnp.asarray(False)
-    r_hint = jnp.asarray(0, I32)
-    r_hint_high = jnp.asarray(0, I32)
+    pre = _Pre(
+        act=act,
+        is_leader=s.role == P.LEADER,
+        is_candidate=is_candidate,
+        is_follower_like=is_follower_like,
+        sender_known=sender_known,
+        sender_slot=sender_slot,
+        noop_reply=noop_reply,
+    )
+    return s, pre
 
-    r_type = sel(noop_reply, MT.NOOP, r_type)
 
-    # ---- Replicate (follower-side; raft.go:1444 handleReplicateMessage) ----
-    h_rep = act & is_follower_like & (mtype == MT.REPLICATE)
+def _empty_resp(s: ShardState, m, pre: _Pre) -> _Resp:
+    return _Resp(
+        r_type=sel(pre.noop_reply, MT.NOOP, jnp.asarray(0, I32)),
+        r_to=m.from_,
+        r_term=s.term,
+        r_log_index=jnp.asarray(0, I32),
+        r_reject=jnp.asarray(False),
+        r_hint=jnp.asarray(0, I32),
+        r_hint_high=jnp.asarray(0, I32),
+    )
+
+
+def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
+    """Follower-side Replicate (raft.go:1444 handleReplicateMessage)."""
+    E = kp.msg_entries
+    h_rep = pre.act & pre.is_follower_like & (m.mtype == MT.REPLICATE)
     s = mrep(s, h_rep, leader=m.from_, e_tick=0)
     below_commit = m.log_index < s.committed
     prev_ok = match_term(kp, s, m.log_index, m.log_term)
@@ -512,42 +548,76 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
         jnp.minimum(last_idx_msg, m.commit), s.last
     )
     s = mrep(s, accept, committed=jnp.maximum(s.committed, commit_to))
-    r_type = sel(h_rep & below_commit, MT.REPLICATE_RESP, r_type)
-    r_log_index = sel(h_rep & below_commit, s.committed, r_log_index)
-    r_type = sel(accept, MT.REPLICATE_RESP, r_type)
-    r_log_index = sel(accept, last_idx_msg, r_log_index)
+    r = r._replace(
+        r_type=sel(h_rep & below_commit, MT.REPLICATE_RESP, r.r_type),
+        r_log_index=sel(h_rep & below_commit, s.committed, r.r_log_index),
+    )
+    r = r._replace(
+        r_type=sel(accept, MT.REPLICATE_RESP, r.r_type),
+        r_log_index=sel(accept, last_idx_msg, r.r_log_index),
+    )
     rejected = h_rep & ~below_commit & (~prev_ok | over_cap)
-    r_type = sel(rejected, MT.REPLICATE_RESP, r_type)
-    r_reject = sel(rejected, True, r_reject)
-    r_log_index = sel(rejected, m.log_index, r_log_index)
-    r_hint = sel(rejected, s.last, r_hint)
+    r = r._replace(
+        r_type=sel(rejected, MT.REPLICATE_RESP, r.r_type),
+        r_reject=sel(rejected, True, r.r_reject),
+        r_log_index=sel(rejected, m.log_index, r.r_log_index),
+        r_hint=sel(rejected, s.last, r.r_hint),
+    )
+    return s, eff, r
 
-    # ---- Heartbeat (raft.go:1398 handleHeartbeatMessage) ----
-    h_hb = act & is_follower_like & (mtype == MT.HEARTBEAT)
+
+def _h_heartbeat(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
+    """Follower-side Heartbeat (raft.go:1398 handleHeartbeatMessage)."""
+    h_hb = pre.act & pre.is_follower_like & (m.mtype == MT.HEARTBEAT)
     s = mrep(s, h_hb, leader=m.from_, e_tick=0,
              committed=jnp.maximum(s.committed, jnp.minimum(m.commit, s.last)))
-    r_type = sel(h_hb, MT.HEARTBEAT_RESP, r_type)
-    r_hint = sel(h_hb, m.hint, r_hint)
-    r_hint_high = sel(h_hb, m.hint_high, r_hint_high)
+    r = r._replace(
+        r_type=sel(h_hb, MT.HEARTBEAT_RESP, r.r_type),
+        r_hint=sel(h_hb, m.hint, r.r_hint),
+        r_hint_high=sel(h_hb, m.hint_high, r.r_hint_high),
+    )
+    return s, eff, r
 
-    # ---- RequestVote (raft.go:1697 handleNodeRequestVote) ----
-    h_rv = act & (mtype == MT.REQUEST_VOTE)
+
+def _h_votereq(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
+    """RequestVote / RequestPreVote / TimeoutNow (raft.go:1697,1670,2188)."""
+    act = pre.act
+    # ---- RequestVote ----
+    h_rv = act & (m.mtype == MT.REQUEST_VOTE)
     can_grant = (s.vote == 0) | (s.vote == m.from_)
     utd = up_to_date(kp, s, m.log_index, m.log_term)
     grant = h_rv & can_grant & utd
     s = mrep(s, grant, vote=m.from_, e_tick=0)
-    r_type = sel(h_rv, MT.REQUEST_VOTE_RESP, r_type)
-    r_reject = sel(h_rv & ~grant, True, r_reject)
-
-    # ---- RequestPreVote (raft.go:1670) ----
-    h_pv = act & (mtype == MT.REQUEST_PREVOTE)
+    r = r._replace(
+        r_type=sel(h_rv, MT.REQUEST_VOTE_RESP, r.r_type),
+        r_reject=sel(h_rv & ~grant, True, r.r_reject),
+    )
+    # ---- RequestPreVote ----
+    h_pv = act & (m.mtype == MT.REQUEST_PREVOTE)
     pv_grant = h_pv & (m.term > s.term) & utd
-    r_type = sel(h_pv, MT.REQUEST_PREVOTE_RESP, r_type)
-    r_term = sel(pv_grant, m.term, r_term)
-    r_reject = sel(h_pv & ~pv_grant, True, r_reject)
+    r = r._replace(
+        r_type=sel(h_pv, MT.REQUEST_PREVOTE_RESP, r.r_type),
+        r_term=sel(pv_grant, m.term, r.r_term),
+        r_reject=sel(h_pv & ~pv_grant, True, r.r_reject),
+    )
+    # ---- TimeoutNow (follower; raft.go:2188) ----
+    h_tn = act & (s.role == P.FOLLOWER) & (m.mtype == MT.TIMEOUT_NOW)
+    s = mrep(s, h_tn, is_ltt=True)
+    s, eff = _campaign(kp, s, eff, h_tn)
+    s = mrep(s, h_tn, is_ltt=False)
+    return s, eff, r
+
+
+def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
+    """Response-side handlers: vote tallies, replication flow control,
+    heartbeat acks, unreachable, snapshot status (raft.go:2246-2267,
+    1878, 1912, 1997, 1975)."""
+    act = pre.act
+    is_leader = pre.is_leader
+    sender_known, sender_slot = pre.sender_known, pre.sender_slot
 
     # ---- RequestVoteResp (candidate; raft.go:2246) ----
-    h_vr = act & (s.role == P.CANDIDATE) & (mtype == MT.REQUEST_VOTE_RESP)
+    h_vr = act & (s.role == P.CANDIDATE) & (m.mtype == MT.REQUEST_VOTE_RESP)
     h_vr = h_vr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
     not_seen = ~s.vresp[sender_slot]
     s = s._replace(
@@ -562,7 +632,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
 
     # ---- RequestPreVoteResp (raft.go:2267) ----
     h_pvr = act & (s.role == P.PRE_VOTE_CANDIDATE) & (
-        mtype == MT.REQUEST_PREVOTE_RESP
+        m.mtype == MT.REQUEST_PREVOTE_RESP
     )
     h_pvr = h_pvr & sender_known & (s.kind[sender_slot] != P.K_NON_VOTING)
     not_seen = ~s.vresp[sender_slot]
@@ -576,7 +646,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     s = _become_follower(s, h_pvr & (votes_against == q), s.term, 0)
 
     # ---- ReplicateResp (leader; raft.go:1878) ----
-    h_rr = act & is_leader & (mtype == MT.REPLICATE_RESP) & sender_known
+    h_rr = act & is_leader & (m.mtype == MT.REPLICATE_RESP) & sender_known
     s = s._replace(active=_set1(s.active, sender_slot, True, h_rr))
     old_match = s.match[sender_slot]
     old_next = s.next[sender_slot]
@@ -590,7 +660,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
                    jnp.maximum(old_next, m.log_index + 1), ok_resp),
         match=_set1(s.match, sender_slot, m.log_index, updated),
     )
-    # wait_to_retry then respondedTo: retry→replicate; snapshot→retry if caught up
+    # wait_to_retry then respondedTo: retry->replicate; snapshot->retry if caught up
     ps = s.pstate[sender_slot]
     ps = sel(updated & (ps == P.R_WAIT), P.R_RETRY, ps)
     ps = sel(updated & (ps == P.R_RETRY), P.R_REPLICATE, ps)
@@ -614,7 +684,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
                   updated & ~commit_advanced & paused),
         )
     )
-    # leadership transfer: target caught up → TimeoutNow (raft.go:1893)
+    # leadership transfer: target caught up -> TimeoutNow (raft.go:1893)
     tn = updated & (s.ltt == m.from_) & (s.match[sender_slot] == s.last)
     eff = eff._replace(send_tn=_set1(eff.send_tn, sender_slot, True, tn))
     # reject: decreaseTo (remote.go:decreaseTo) + resend
@@ -637,7 +707,7 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     eff = eff._replace(need_rep=_set1(eff.need_rep, sender_slot, True, dec))
 
     # ---- HeartbeatResp (leader; raft.go:1912) ----
-    h_hr = act & is_leader & (mtype == MT.HEARTBEAT_RESP) & sender_known
+    h_hr = act & is_leader & (m.mtype == MT.HEARTBEAT_RESP) & sender_known
     s = s._replace(
         active=_set1(s.active, sender_slot, True, h_hr),
         pstate=_set1(s.pstate, sender_slot, P.R_RETRY,
@@ -651,20 +721,14 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
     s = jax.tree_util.tree_map(lambda a, b: sel(conf, a, b), s_c, s)
     eff = jax.tree_util.tree_map(lambda a, b: sel(conf, a, b), eff_c, eff)
 
-    # ---- TimeoutNow (follower; raft.go:2188) ----
-    h_tn = act & (s.role == P.FOLLOWER) & (mtype == MT.TIMEOUT_NOW)
-    s = mrep(s, h_tn, is_ltt=True)
-    s, eff = _campaign(kp, s, eff, h_tn)
-    s = mrep(s, h_tn, is_ltt=False)
-
     # ---- Unreachable (leader; raft.go:1997) ----
-    h_un = act & is_leader & (mtype == MT.UNREACHABLE) & sender_known
+    h_un = act & is_leader & (m.mtype == MT.UNREACHABLE) & sender_known
     s = s._replace(pstate=_set1(
         s.pstate, sender_slot, P.R_RETRY,
         h_un & (s.pstate[sender_slot] == P.R_REPLICATE)))
 
     # ---- SnapshotStatus (leader, immediate variant; raft.go:1975) ----
-    h_ss = act & is_leader & (mtype == MT.SNAPSHOT_STATUS) & sender_known
+    h_ss = act & is_leader & (m.mtype == MT.SNAPSHOT_STATUS) & sender_known
     in_snap = s.pstate[sender_slot] == P.R_SNAPSHOT
     # becomeWait: next = max(match+1, psnap+1) on success; clear psnap on reject
     nn = sel(
@@ -676,9 +740,30 @@ def _process_message(kp: P.KernelParams, s: ShardState, eff: Effects, m):
         psnap=_set1(s.psnap, sender_slot, 0, h_ss & in_snap),
         pstate=_set1(s.pstate, sender_slot, P.R_WAIT, h_ss & in_snap),
     )
+    return s, eff, r
 
-    resp = (r_type, r_to, r_term, r_log_index, r_reject, r_hint, r_hint_high)
-    return s, eff, resp
+
+_FAMILY_HANDLERS = {
+    "rep": (_h_replicate,),
+    "hb": (_h_heartbeat,),
+    "vote": (_h_votereq,),
+    "resp": (_h_resp,),
+    "any": (_h_replicate, _h_heartbeat, _h_votereq, _h_resp),
+}
+
+
+def _process_family(kp: P.KernelParams, family: str, s: ShardState,
+                    eff: Effects, m):
+    """One inbound message against one shard, with only ``family``'s
+    handlers compiled in — the dispatch-by-type analog of raft.Handle
+    (raft.go:1596).  'any' composes every handler (masks are mutually
+    exclusive per message type, so composition order cannot change the
+    result for a single message)."""
+    s, pre = _preamble(kp, s, m)
+    r = _empty_resp(s, m, pre)
+    for h in _FAMILY_HANDLERS[family]:
+        s, eff, r = h(kp, s, eff, m, pre, r)
+    return s, eff, r
 
 
 # ---------------------------------------------------------------------------
@@ -698,13 +783,37 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     # 0. host-confirmed applied cursor
     s = s._replace(applied=jnp.maximum(s.applied, inp.applied))
 
-    # 1. inbox scan — lax.scan so the (large) message processor compiles once
-    def _scan_msg(carry, m):
-        s_, eff_ = carry
-        s_, eff_, resp = _process_message(kp, s_, eff_, m)
-        return (s_, eff_), resp
+    # 1. inbox processing — slots grouped by their static family
+    # (params.slot_families): each family's scan body compiles ONLY that
+    # family's handlers, cutting the serial full-matrix cost by ~4x on
+    # the router's typed layout (PERF.md lever #1).  'any' slots keep the
+    # full matrix for host-staged arbitrary traffic.
+    fams = P.slot_families(K)
+    order = []
+    for fam in ("resp", "rep", "hb", "vote", "any"):
+        idxs = [k for k, f in enumerate(fams) if f == fam]
+        if idxs:
+            order.append((fam, idxs))
+    r_parts = []
+    for fam, idxs in order:
+        if idxs == list(range(K)):
+            sub = box
+        else:
+            gather = jnp.asarray(idxs, I32)
+            sub = jax.tree_util.tree_map(lambda a: a[gather], box)
 
-    (s, eff), r_stack = jax.lax.scan(_scan_msg, (s, eff), box)
+        def _scan_msg(carry, m, _fam=fam):
+            s_, eff_ = carry
+            s_, eff_, r = _process_family(kp, _fam, s_, eff_, m)
+            return (s_, eff_), tuple(r)
+
+        (s, eff), part = jax.lax.scan(_scan_msg, (s, eff), sub)
+        r_parts.append(part)
+    r_stack = tuple(
+        jnp.concatenate([p[i] for p in r_parts], axis=0)
+        if len(r_parts) > 1 else r_parts[0][i]
+        for i in range(7)
+    )
 
     # 2. batched ReadIndex request (node.go:1296 handleReadIndex batches all
     #    queued reads under one ctx; host routes to the leader replica)

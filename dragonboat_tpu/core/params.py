@@ -34,6 +34,26 @@ class KernelParams:
         assert self.readindex_cap & (self.readindex_cap - 1) == 0
 
 
+def slot_families(K: int) -> tuple[str, ...]:
+    """Static per-slot message families for the kernel inbox.
+
+    The device router's slot layout (router.py) is typed: per remote peer,
+    two response lanes, a replicate lane, a heartbeat lane and a
+    vote/TimeoutNow lane.  Exposing that statically lets the kernel scan
+    each family with a body containing ONLY that family's handlers —
+    the dispatch-by-type restructuring that removes most of the serial
+    inbox-scan cost (PERF.md lever #1).  Slots beyond whole 5-slot units
+    are 'any': they accept every type and run the full handler body
+    (hosts staging arbitrary network traffic use these).
+
+    resp: *_RESP, NOOP, UNREACHABLE, SNAPSHOT_STATUS
+    rep:  REPLICATE      hb: HEARTBEAT
+    vote: REQUEST_VOTE, REQUEST_PREVOTE, TIMEOUT_NOW
+    """
+    u = K // 5
+    return ("resp", "resp", "rep", "hb", "vote") * u + ("any",) * (K - 5 * u)
+
+
 # role encoding — parity with pycore.RaftState / raft.go:63-71
 FOLLOWER = 0
 CANDIDATE = 1

@@ -60,7 +60,7 @@ _LOG = get_logger("engine")
 MT = pb.MessageType
 
 # message types a kernel lane consumes directly (core/kernel.py
-# _process_message dispatch set)
+# _process_family dispatch set)
 _KERNEL_MTYPES = frozenset({
     MT.REPLICATE, MT.REPLICATE_RESP, MT.HEARTBEAT, MT.HEARTBEAT_RESP,
     MT.REQUEST_VOTE, MT.REQUEST_VOTE_RESP, MT.REQUEST_PREVOTE,
@@ -854,9 +854,27 @@ class KernelEngine:
 # ---------------------------------------------------------------------------
 
 
+_FAMILY_OF_TYPE = {
+    int(pb.MessageType.REPLICATE): "rep",
+    int(pb.MessageType.HEARTBEAT): "hb",
+    int(pb.MessageType.REQUEST_VOTE): "vote",
+    int(pb.MessageType.REQUEST_PREVOTE): "vote",
+    int(pb.MessageType.TIMEOUT_NOW): "vote",
+}
+# everything else (responses, NOOP, UNREACHABLE, SNAPSHOT_STATUS) -> "resp"
+
+
 class _InboxBuilder:
     def __init__(self, G: int, K: int, E: int) -> None:
         self.K, self.E = K, E
+        # typed slot layout (params.slot_families): a message may only be
+        # staged into a slot whose family accepts its type ('any' accepts
+        # all) — the kernel compiles family-specialized handlers per slot
+        fams = KP.slot_families(K)
+        self._slots_for = {}
+        for fam in ("rep", "hb", "vote", "resp"):
+            self._slots_for[fam] = tuple(
+                k for k, f in enumerate(fams) if f in (fam, "any"))
         self.mtype = np.zeros((G, K), np.int32)
         self.from_ = np.zeros((G, K), np.int32)
         self.term = np.zeros((G, K), np.int32)
@@ -869,20 +887,22 @@ class _InboxBuilder:
         self.n_ent = np.zeros((G, K), np.int32)
         self.ent_term = np.zeros((G, K, E), np.int32)
         self.ent_cc = np.zeros((G, K, E), bool)
-        self._fill = np.zeros((G,), np.int32)
 
     def reset(self) -> None:
         for a in (self.mtype, self.from_, self.term, self.log_term,
                   self.log_index, self.commit, self.reject, self.hint,
-                  self.hint_high, self.n_ent, self.ent_term, self.ent_cc,
-                  self._fill):
+                  self.hint_high, self.n_ent, self.ent_term, self.ent_cc):
             a.fill(0)
 
     def add(self, g: int, m: pb.Message, n: KernelNode) -> bool:
-        k = int(self._fill[g])
-        if k >= self.K:
-            return False
-        self._fill[g] += 1
+        fam = _FAMILY_OF_TYPE.get(int(m.type), "resp")
+        k = -1
+        for cand in self._slots_for[fam]:
+            if self.mtype[g, cand] == 0:
+                k = cand
+                break
+        if k < 0:
+            return False  # family full this step; host requeues the message
         self.mtype[g, k] = int(m.type)
         self.from_[g, k] = m.from_
         self.term[g, k] = m.term

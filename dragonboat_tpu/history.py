@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass
@@ -76,12 +76,6 @@ class HistoryRecorder:
                 }) + "\n")
 
 
-@dataclass
-class _Ent:
-    op: Op
-    concurrent: set[int] = field(default_factory=set)
-
-
 def check_linearizable_kv(ops: list[Op], initial=None) -> bool:
     """Check a register history per key (writes + reads).
 
@@ -109,6 +103,15 @@ def _check_register(ops: list[Op], initial) -> bool:
 
     ops = sorted(ops, key=lambda o: o.call)
     seen: set[tuple[frozenset, object]] = set()
+
+    def memo_key(value):
+        # values may be unhashable (dicts/lists from user SMs); the memo
+        # key only needs equality-consistency, so canonicalize via repr
+        try:
+            hash(value)
+            return value
+        except TypeError:
+            return repr(value)
 
     def minimal(done: frozenset) -> list[int]:
         """Ops not done whose every predecessor is done."""
@@ -139,14 +142,14 @@ def _check_register(ops: list[Op], initial) -> bool:
     stack = [choices(frozenset(), initial)]
     if n == 0:
         return True
-    seen.add((frozenset(), initial))
+    seen.add((frozenset(), memo_key(initial)))
     while stack:
         it = stack[-1]
         advanced = False
         for done, value in it:
             if len(done) == n:
                 return True
-            key = (done, value)
+            key = (done, memo_key(value))
             if key in seen:
                 continue
             seen.add(key)

@@ -17,8 +17,6 @@ import struct
 import threading
 import zlib
 
-from dragonboat_tpu import native as _native
-
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
 
@@ -222,7 +220,7 @@ class TCPTransport(ITransport):
                 raw = _recv_exact(sock, _REQ_HDR.size)
                 method, size, pcrc = _decode_header(raw)
                 payload = _recv_exact(sock, size)
-                if not _native.frame_check(payload, pcrc):
+                if zlib.crc32(payload) != pcrc:
                     raise ValueError("payload crc mismatch")
                 if method == RAFT_TYPE:
                     self.message_handler(pb.decode_message_batch(payload))
