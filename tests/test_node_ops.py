@@ -337,3 +337,64 @@ def test_proposal_queue_bound():
                 node.propose(sess, b"q", 100)
     finally:
         nh.close()
+
+
+# ---------------------------------------------------------------------------
+# quiesce at scale: thousands of idle shards ~ free (README.md:50 of the
+# reference — "idle groups are approximately free"; quiesce.go:36)
+# ---------------------------------------------------------------------------
+
+
+def test_quiesce_scale_idle_shards_are_free():
+    """200 idle single-replica shards on one host: once quiesced, the
+    engine finds NO step work (run_once() == 0) and terms freeze — idle
+    shards cost ticks only, mirroring the reference's headline claim."""
+    shards = tuple(range(1, 201))
+    nh = NodeHost(NodeHostConfig(raft_address="qsc-1", rtt_millisecond=2),
+                  auto_run=False)
+    try:
+        for sid in shards:
+            nh.start_replica({1: "qsc-1"}, False, KVStateMachine, Config(
+                shard_id=sid, replica_id=1, election_rtt=5, heartbeat_rtt=1,
+                quiesce=True))
+        # elect every shard (single member: first election tick wins)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            nh.tick_all()
+            nh.run_once()
+            if all(nh.get_leader_id(s)[1] for s in shards):
+                break
+        assert all(nh.get_leader_id(s)[1] for s in shards)
+
+        def drive(rs, deadline_s=10):
+            # auto_run=False: nothing steps the nodes, so the test drives
+            # the engine until the proposal future completes
+            end = time.time() + deadline_s
+            while time.time() < end and not rs._event.is_set():
+                nh.tick_all()
+                nh.run_once()
+            assert rs._event.is_set(), "proposal never completed"
+            return rs
+
+        s = nh.get_noop_session(1)
+        drive(nh.propose(s, b"w=1"))
+        # idle: tick until every shard enters quiesce (threshold ~50 ticks)
+        for _ in range(80):
+            nh.tick_all()
+            nh.run_once()
+        assert all(n.qs.quiesced() for n in nh.nodes.values()), \
+            f"{sum(n.qs.quiesced() for n in nh.nodes.values())}/200 quiesced"
+        terms = {sid: n.peer.raft.term for sid, n in nh.nodes.items()}
+        # quiesced ticks generate no step work
+        steps = 0
+        for _ in range(30):
+            nh.tick_all()
+            steps += nh.run_once()
+        assert steps == 0, f"quiesced shards still produced {steps} steps"
+        assert terms == {sid: n.peer.raft.term for sid, n in nh.nodes.items()}
+        # and activity on one shard wakes exactly that shard
+        drive(nh.propose(nh.get_noop_session(7), b"wake=1"))
+        assert not nh.nodes[7].qs.quiesced()
+        assert nh.nodes[8].qs.quiesced()
+    finally:
+        nh.close()
