@@ -13,7 +13,9 @@ Baseline: the reference's 9M writes/s peak (3× 22-core Xeon servers,
 BASELINE.md) — vs_baseline is measured/9e6.
 
 Env knobs: BENCH_GROUPS (default 8192), BENCH_STEPS (default 200),
-BENCH_PROBE_TIMEOUT (default 180 s), BENCH_FORCE_CPU=1.
+BENCH_PROBE_TIMEOUT (default 180 s), BENCH_FORCE_CPU=1, BENCH_DEVICE_SM=1
+(run the full data path: committed writes applied to the device-resident
+KV state machine by the fused rsm-apply kernel, rsm/device_kv.py).
 """
 
 import json
@@ -103,7 +105,7 @@ def run_bench() -> None:
 def _measure(platform: str, groups: int, steps: int) -> None:
     import numpy as np
 
-    from dragonboat_tpu.bench_loop import (
+    from dragonboat_tpu.bench_loop import (  # noqa: F401
         bench_params,
         elect_all,
         make_cluster,
@@ -112,13 +114,32 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     from dragonboat_tpu.core import params as KP
 
     replicas = 3
-    kp = bench_params(replicas)
+    device_sm = os.environ.get("BENCH_DEVICE_SM") == "1"
+    if device_sm:
+        from dragonboat_tpu.bench_loop import sm_params
+
+        kp = sm_params(replicas)
+    else:
+        kp = bench_params(replicas)
 
     t_build = time.time()
     state = make_cluster(kp, groups, replicas)
     state, box = elect_all(kp, replicas, state)
     lead = np.asarray(state.role) == KP.LEADER
     assert lead.reshape(-1, replicas).any(axis=1).all()
+    sm_rejects = []   # device arrays: no per-chunk host sync in the
+    # timed loop (the plain path measures with async dispatch overlap)
+    if device_sm:
+        from dragonboat_tpu.bench_loop import make_device_sm, run_steps_sm
+
+        kv, kv_state = make_device_sm(groups, replicas)
+
+        def run_steps(kp_, r_, n_, tick_, prop_, st_, bx_):
+            nonlocal kv_state
+            st_, bx_, kv_state, rej = run_steps_sm(
+                kp_, r_, kv, n_, tick_, prop_, st_, bx_, kv_state)
+            sm_rejects.append(rej)
+            return st_, bx_
 
     # warmup: compile exactly the loop variants the timed region will run
     # (iters is a static jit arg — chunk and remainder sizes each compile).
@@ -135,6 +156,7 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     state.term.block_until_ready()
     compile_s = time.time() - t_compile
 
+    sm_rejects.clear()  # warmup-phase rejects are outside the window
     c0 = np.asarray(state.committed)[lead].astype(np.int64).sum()
     # chunk the device loop: one fori_loop launch of N*step_ms can trip
     # the TPU watchdog ("TPU device error") when a run exceeds ~60 s —
@@ -151,8 +173,10 @@ def _measure(platform: str, groups: int, steps: int) -> None:
 
     writes = int(c1 - c0)
     wps = writes / dt
+    sm_note = ", device-SM apply" if device_sm else ""
     emit({
-        "metric": f"replicated writes/sec, {groups} groups x 3 replicas, 16B",
+        "metric": (f"replicated writes/sec, {groups} groups x 3 replicas, "
+                   f"16B{sm_note}"),
         "value": round(wps),
         "unit": "writes/s",
         "vs_baseline": round(wps / BASELINE_WPS, 4),
@@ -166,6 +190,8 @@ def _measure(platform: str, groups: int, steps: int) -> None:
             "writes_per_group_step": round(writes / steps / groups, 2),
             "warmup_steps_s": round(compile_s, 1),
             "total_setup_s": round(t0 - t_build, 1),
+            **({"sm_rejected_writes": int(sum(int(r) for r in sm_rejects))}
+               if device_sm else {}),
         },
     })
 

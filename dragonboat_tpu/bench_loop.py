@@ -79,6 +79,9 @@ def full_step(kp: KP.KernelParams, replicas: int, state: ShardState,
     B = kp.proposal_cap
     is_leader = state.role == KP.LEADER
     pv = jnp.broadcast_to(is_leader[:, None], (G, B)) & propose
+    # inline payloads: lane j proposes value (last + 1 + j) — the entry's
+    # own index, so any replica can verify lv[slot(i)] == i for committed i
+    pval = (state.last[:, None] + 1 + jnp.arange(B, dtype=jnp.int32)[None, :])
     inp = StepInput(
         prop_valid=pv,
         prop_cc=jnp.zeros((G, B), bool),
@@ -89,6 +92,7 @@ def full_step(kp: KP.KernelParams, replicas: int, state: ShardState,
         tick=jnp.broadcast_to(jnp.asarray(tick, bool), (G,)),
         quiesced=jnp.zeros((G,), bool),
         applied=state.processed,  # instant-apply RSM feedback
+        prop_val=pval,
     )
     state, out = step(kp, state, box, inp)
     nxt = route(kp, replicas, out)
@@ -108,6 +112,81 @@ def run_steps(kp: KP.KernelParams, replicas: int, iters: int,
         return st, bx
 
     return jax.lax.fori_loop(0, iters, body, (state, box))
+
+
+# ---------------------------------------------------------------------------
+# device-SM pipeline: the full propose -> replicate -> commit -> APPLY loop
+# with the rsm-apply kernel (rsm/device_kv.py) fused into the step
+# ---------------------------------------------------------------------------
+
+
+def sm_params(replicas: int = 3) -> KP.KernelParams:
+    """bench_params with the inline-payload lanes enabled (the lv ring +
+    ent_val routing the device-SM data path rides)."""
+    import dataclasses
+
+    return dataclasses.replace(bench_params(replicas), inline_payloads=True)
+
+
+def make_device_sm(num_groups: int, replicas: int = 3,
+                   table_cap: int = 1024):
+    """(DeviceKV, kv_state) sized for the bench cluster.  The key space
+    (table_cap/2 distinct keys) stays at load factor <= 0.5 so the probe
+    window never fills in steady state."""
+    from dragonboat_tpu.rsm.device_kv import DeviceKV
+
+    G = num_groups * replicas
+    # direct-mapped: the bench key space (table_cap/2 keys) is collision-
+    # free by construction, so NO committed write is ever rejected
+    kv = DeviceKV(table_cap=table_cap, hash_keys=False)
+    return kv, kv.init_state(G)
+
+
+def full_step_sm(kp: KP.KernelParams, replicas: int, kv, state: ShardState,
+                 box: Inbox, kv_state, tick, propose):
+    """``full_step`` plus the device RSM: payloads ride the lv ring (the
+    inline payload slot — proposals stamp it, replicate messages carry
+    it, so FOLLOWERS hold real values too), and the apply window the
+    kernel releases is applied to the DeviceKV by the fused rsm-apply
+    kernel on every replica.  This is the north star's full data path —
+    the reference benches apply to an in-memory KV on the host
+    (kvtest.go); here the apply itself is device work."""
+    assert kp.inline_payloads, "device-SM path needs sm_params()"
+    CAP, AB = kp.log_cap, kp.apply_batch
+    state, box2, out = full_step(kp, replicas, state, box, tick, propose)
+    # apply the released window through the rsm-apply kernel, reading
+    # payloads from the replicated lv ring (valid on leaders AND followers)
+    idx = out.apply_first[:, None] + jnp.arange(AB, dtype=jnp.int32)[None, :]
+    valid = idx <= out.apply_last[:, None]                   # [G, AB]
+    vals = jnp.take_along_axis(state.lv, idx & (CAP - 1), axis=1)
+    # half the table's slots as key space: load factor <= 0.5, so probe
+    # windows do not fill up and reject committed writes
+    keys = idx & (kv.table_cap // 2 - 1)
+    cmds = jnp.stack([keys, vals], axis=-1)                  # [G, AB, 2]
+    kv_state, (_results, ok) = kv.apply_kernel(kv_state, cmds, valid)
+    # a rejected committed write must be surfaced, not swallowed —
+    # the bench reports the count
+    n_rejected = jnp.sum(~ok & valid)
+    return state, box2, kv_state, n_rejected, out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def run_steps_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
+                 tick, propose, state, box, kv_state):
+    """iters device-SM pipeline steps under one jit (module-level: the
+    executable caches across calls — kp/kv are hashable statics)."""
+    tick = jnp.asarray(tick, bool)
+    propose = jnp.asarray(propose, bool)
+
+    def body(_, carry):
+        st, bx, ks, rej = carry
+        st, bx, ks, r, _ = full_step_sm(kp, replicas, kv, st, bx, ks,
+                                        tick, propose)
+        return st, bx, ks, rej + r
+
+    return jax.lax.fori_loop(
+        0, iters, body,
+        (state, box, kv_state, jnp.asarray(0, jnp.int32)))
 
 
 def elect_all(kp: KP.KernelParams, replicas: int, state: ShardState,

@@ -257,12 +257,16 @@ def _set_row(arr, idx, val, mask):
     return jnp.where(oh[:, None], val, arr)
 
 
-def _append_one(kp, s: ShardState, mask, term, is_cc) -> ShardState:
+def _append_one(kp, s: ShardState, mask, term, is_cc,
+                val=None) -> ShardState:
     idx = s.last + 1
     slot = _slot(kp, idx)
     lt = _set1(s.lt, slot, term, mask)
     lcc = _set1(s.lcc, slot, is_cc, mask)
     s = s._replace(lt=lt, lcc=lcc)
+    if kp.inline_payloads:
+        v = jnp.asarray(0, I32) if val is None else val
+        s = s._replace(lv=_set1(s.lv, slot, v, mask))
     return mrep(s, mask, last=idx)
 
 
@@ -533,6 +537,11 @@ def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
         lt=jnp.where(slot_written, m.ent_term[lane_of_slot], s.lt),
         lcc=jnp.where(slot_written, m.ent_cc[lane_of_slot], s.lcc),
     )
+    if kp.inline_payloads:
+        m_val = (m.ent_val if m.ent_val is not None
+                 else jnp.zeros_like(m.ent_term))
+        s = s._replace(
+            lv=jnp.where(slot_written, m_val[lane_of_slot], s.lv))
     new_last_if_append = m.log_index + m.n_ent
     s = mrep(s, do_append, last=new_last_if_append,
              stable=jnp.minimum(s.stable, m.log_index + append_from_lane))
@@ -847,9 +856,12 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     # 3. proposals (leader only, not while transferring; raft.go:1794)
     can_prop = is_leader & (s.ltt == 0)
 
+    prop_vals = (inp.prop_val if inp.prop_val is not None
+                 else jnp.zeros_like(inp.prop_cc, I32))
+
     def _scan_prop(carry, pv):
         s_, eff_, appended = carry
-        v_, is_cc_ = pv
+        v_, is_cc_, val_ = pv
         # ring-capacity guard: refuse proposals that would overflow the term
         # ring (host sees prop_accepted=False → system busy, mirroring the
         # reference's in-mem log rate limiting; compaction frees space)
@@ -859,7 +871,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
         cc_ok = v_ & is_cc_ & ~s_.pending_cc
         drop_cc = v_ & is_cc_ & s_.pending_cc
         do = v_ & (~is_cc_ | cc_ok)
-        s_ = _append_one(kp, s_, do, s_.term, is_cc_ & cc_ok)
+        s_ = _append_one(kp, s_, do, s_.term, is_cc_ & cc_ok, val_)
         s_ = mrep(s_, cc_ok, pending_cc=True)
         eff_ = eff_._replace(save_from=sel(
             do, jnp.minimum(eff_.save_from, s_.last), eff_.save_from))
@@ -868,7 +880,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
 
     (s, eff, appended_any), (prop_accepted, prop_index, prop_term) = jax.lax.scan(
         _scan_prop, (s, eff, jnp.asarray(False)),
-        (inp.prop_valid, inp.prop_cc),
+        (inp.prop_valid, inp.prop_cc, prop_vals),
     )
     self_mask = _self_slot_mask(s)
     s = s._replace(
@@ -957,6 +969,8 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     eslot = _slot(kp, ent_idx)
     ent_term = sel(ent_live, s.lt[eslot], 0)
     ent_cc = sel(ent_live, s.lcc[eslot], False)
+    ent_val = (sel(ent_live, s.lv[eslot], 0)
+               if kp.inline_payloads else None)
     # optimistic pipelined advance (remote.go:progress)
     adv = send_rep & (s.pstate == P.R_REPLICATE) & (n_avail > 0)
     s = s._replace(
@@ -1023,7 +1037,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
         s_rep=send_rep, s_prev_index=prev, s_prev_term=sel(prev_comp, 0, prev_term),
         s_commit=jnp.broadcast_to(s.committed, (Pn,)),
         s_n_ent=sel(send_rep, n_avail, 0),
-        s_ent_term=ent_term, s_ent_cc=ent_cc,
+        s_ent_term=ent_term, s_ent_cc=ent_cc, s_ent_val=ent_val,
         s_vote=sel(vr, eff.send_vote, 0),
         s_vote_term=jnp.broadcast_to(vote_term, (Pn,)),
         s_vote_lindex=jnp.broadcast_to(s.last, (Pn,)),

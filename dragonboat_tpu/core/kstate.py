@@ -78,6 +78,11 @@ class ShardState(NamedTuple):
     # (e.g. a peer needs an InstallSnapshot stream) — host must intervene
     needs_host: jnp.ndarray     # [G] bool
 
+    # inline payload slot ring [G, CAP] i32 (SURVEY §7: small fixed-width
+    # values on device; bigger payloads stay host-side keyed by index).
+    # None unless kp.inline_payloads — the plain path carries no ring.
+    lv: jnp.ndarray | None = None
+
 
 def init_state(
     kp: P.KernelParams,
@@ -165,6 +170,7 @@ def init_state(
         vgrant=jnp.asarray(zb(Pn)),
         lt=jnp.asarray(z(CAP)),
         lcc=jnp.asarray(zb(CAP)),
+        lv=jnp.asarray(z(CAP)) if kp.inline_payloads else None,
         snap_index=jnp.asarray(z()),
         snap_term=jnp.asarray(z()),
         last=jnp.asarray(z()),
@@ -200,15 +206,21 @@ class Inbox(NamedTuple):
     n_ent: jnp.ndarray      # i32 — entries carried (replicate)
     ent_term: jnp.ndarray   # [G, K, E] i32
     ent_cc: jnp.ndarray     # [G, K, E] bool
+    # inline payload lanes; None (default) when the sender keeps payloads
+    # host-side (the kernel substitutes zeros)
+    ent_val: jnp.ndarray | None = None
 
 
 def empty_inbox(kp: P.KernelParams, num_shards: int) -> Inbox:
     G, K, E = num_shards, kp.inbox_cap, kp.msg_entries
     z = lambda *s: jnp.zeros((G, *s), jnp.int32)  # noqa: E731
+    # ent_val is materialized only under inline_payloads so the
+    # self-driving loop's carry matches route()'s output structure
     return Inbox(
         mtype=z(K), from_=z(K), term=z(K), log_term=z(K), log_index=z(K),
         commit=z(K), reject=jnp.zeros((G, K), bool), hint=z(K), hint_high=z(K),
         n_ent=z(K), ent_term=z(K, E), ent_cc=jnp.zeros((G, K, E), bool),
+        ent_val=z(K, E) if kp.inline_payloads else None,
     )
 
 
@@ -230,6 +242,8 @@ class StepInput(NamedTuple):
     quiesced: jnp.ndarray       # [G] bool — tick in quiesced mode
     # host acks: RSM applied cursor (monotonic)
     applied: jnp.ndarray        # [G] i32
+    # inline proposal payloads (device-SM path); None = host-side payloads
+    prop_val: jnp.ndarray | None = None
 
 
 def empty_input(kp: P.KernelParams, num_shards: int) -> StepInput:
@@ -264,6 +278,8 @@ class StepOutput(NamedTuple):
     s_n_ent: jnp.ndarray
     s_ent_term: jnp.ndarray  # [G, P, E]
     s_ent_cc: jnp.ndarray    # [G, P, E] bool
+    # [G, P, E] i32 inline payload lanes; None unless kp.inline_payloads
+    s_ent_val: jnp.ndarray | None
     s_vote: jnp.ndarray      # i32: 0 none, 1 RequestVote, 2 RequestPreVote
     s_vote_term: jnp.ndarray
     s_vote_lindex: jnp.ndarray

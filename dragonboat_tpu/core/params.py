@@ -28,6 +28,9 @@ class KernelParams:
     readindex_cap: int = 8      # RI: pending ReadIndex contexts per shard
     apply_batch: int = 64       # max committed entries released per step
     compaction_overhead: int = 64  # retained entries below the compact floor
+    # inline payload lanes (lv ring + ent_val routing) for device-resident
+    # RSMs; off by default — host-side-payload deployments skip the cost
+    inline_payloads: bool = False
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

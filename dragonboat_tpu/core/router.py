@@ -97,6 +97,8 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
     rep_n = peer_lane(out.s_n_ent)
     rep_ent_t = peer_lane(out.s_ent_term)                    # [N, Rt, Rs, E]
     rep_ent_cc = peer_lane(out.s_ent_cc)
+    inline = out.s_ent_val is not None
+    rep_ent_v = peer_lane(out.s_ent_val) if inline else None
     hb_valid = peer_lane(out.s_hb)
     hb_commit = peer_lane(out.s_hb_commit)
     hb_low = peer_lane(out.s_hb_low)
@@ -128,6 +130,8 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
         "ent_term": jnp.zeros((N, R, K, E), I32),
         "ent_cc": jnp.zeros((N, R, K, E), bool),
     }
+    if inline:
+        fields["ent_val"] = jnp.zeros((N, R, K, E), I32)
 
     # enumerate the R-1 remote sources for each target: s = (t + 1 + q) % R
     t_iota = jnp.arange(R, dtype=I32)
@@ -188,6 +192,9 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
             jnp.where(v[..., None], take4(rep_ent_t), 0))
         fields["ent_cc"] = fields["ent_cc"].at[:, :, k_slot].set(
             jnp.where(v[..., None], take4(rep_ent_cc), False))
+        if inline:
+            fields["ent_val"] = fields["ent_val"].at[:, :, k_slot].set(
+                jnp.where(v[..., None], take4(rep_ent_v), 0))
         # heartbeat
         v = take(hb_valid)
         k_slot = base + 3

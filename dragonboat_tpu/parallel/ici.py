@@ -117,6 +117,8 @@ def _ici_body(kp: KP.KernelParams, replicas: int,
     n_local = state.term.shape[0]
 
     def to_grouped(x):  # [R, n_local, ...] -> [n_local * R, ...] group-major
+        if x is None:  # optional lanes (e.g. s_ent_val without payloads)
+            return None
         x = jnp.swapaxes(x, 0, 1)
         return x.reshape((n_local * R,) + x.shape[2:])
 

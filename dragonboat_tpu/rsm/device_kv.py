@@ -1,0 +1,142 @@
+"""DeviceKV — the device-native state machine (IDeviceStateMachine).
+
+The north star's rsm-apply kernel (BASELINE.json; SURVEY §7.4 "in-memory
+KV state machine applied as a fused on-device kernel"): committed entry
+lanes are applied to a per-shard open-addressing hash table that lives in
+HBM, vmapped across the ``[G]`` shard axis — the device analog of the
+reference's in-memory KV RSM (internal/tests/kvtest.go) that its
+benchmarks apply on the host.
+
+Design constraints shared with the raft kernel (core/kernel.py):
+
+- scatter-free: every table write is a one-hot select (vmapped sub-32-bit
+  scatters miscompile on TPU; selects vectorize better anyway);
+- fixed shapes: table capacity and probe depth are static; a full probe
+  window rejects the write (result -1) instead of growing;
+- int32 lanes: keys/values are i32 (the bench's 16-byte payloads are
+  (key, value) pairs; bigger payloads stay host-side by design — the
+  device holds what the data path needs).
+
+Keys are stored +1 so 0 stays the empty sentinel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dragonboat_tpu.core.params import splitmix32
+from dragonboat_tpu.statemachine import IDeviceStateMachine
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceKV(IDeviceStateMachine):
+    """Fixed-capacity linear-probe hash table per shard.
+
+    Frozen/hashable so it can ride as a jit static argument (the bench's
+    run_steps_sm caches its executable on (kp, replicas, kv, iters)).
+    Keys must be >= 0 (the +1 storage offset reserves 0 as the empty
+    sentinel); negative keys are rejected at the apply boundary and
+    return None from lookup."""
+
+    table_cap: int = 1024
+    probe_depth: int = 8
+    # hash_keys=False direct-maps key -> slot key & (cap-1): with a key
+    # space <= table_cap no two keys share a home slot, so inserts can
+    # never be rejected — the bench uses this for its strict no-loss
+    # contract; hashed mode serves arbitrary key spaces (with -1 rejects
+    # when a probe window fills, as any fixed-capacity table must)
+    hash_keys: bool = True
+
+    def __post_init__(self) -> None:
+        assert self.table_cap & (self.table_cap - 1) == 0, \
+            "table_cap must be 2^n"
+
+    def init_state(self, num_shards: int) -> dict:
+        T = self.table_cap
+        return {
+            "keys": jnp.zeros((num_shards, T), I32),   # stored key+1; 0=empty
+            "vals": jnp.zeros((num_shards, T), I32),
+            "count": jnp.zeros((num_shards,), I32),
+        }
+
+    # -- apply -----------------------------------------------------------
+
+    def _probe_slots(self, key):
+        if self.hash_keys:
+            h = (splitmix32(key.astype(jnp.uint32)).astype(I32)
+                 & (self.table_cap - 1))
+        else:
+            h = key & (self.table_cap - 1)
+        return (h + jnp.arange(self.probe_depth, dtype=I32)) & (self.table_cap - 1)
+
+    def _put_one(self, keys, vals, count, key, val, valid):
+        """Insert/update one (key, val); scatter-free one-hot write."""
+        slots = self._probe_slots(key)                       # [D]
+        pk = keys[slots]                                     # [D]
+        hit = pk == key + 1
+        empty = pk == 0
+        usable = hit | empty
+        found = jnp.any(usable)
+        # first matching slot wins; else first empty (linear probe order)
+        first_hit = jnp.argmax(hit)
+        pick = jnp.where(jnp.any(hit), first_hit, jnp.argmax(empty))
+        slot = slots[pick]
+        do = valid & found & (key >= 0)
+        is_new = do & ~jnp.any(hit)
+        oh = (jnp.arange(keys.shape[0], dtype=I32) == slot) & do
+        keys = jnp.where(oh, key + 1, keys)
+        vals = jnp.where(oh, val, vals)
+        count = count + jnp.where(is_new, 1, 0)
+        # ok is a separate status flag: payloads are arbitrary i32, so a
+        # stored value of -1 must stay distinguishable from a reject
+        ok = do
+        result = jnp.where(do, val, -1)
+        return keys, vals, count, result, ok
+
+    def apply_kernel(self, sm_state: dict, cmd_lanes, valid_mask):
+        """Apply ``[G, B, 2]`` (key, value) command lanes where
+        ``valid_mask [G, B]`` holds; returns (new_state,
+        (results [G, B] i32, ok [G, B] bool)) — ok False on a valid lane
+        means the probe window was full and the write was rejected.
+        Lanes apply in order (later writes to the same key win), matching
+        sequential host apply semantics."""
+
+        def per_shard(keys, vals, count, cmds, valid):
+            def body(carry, x):
+                k, v, c = carry
+                cmd, lane_ok = x
+                k, v, c, r, okf = self._put_one(k, v, c, cmd[0], cmd[1],
+                                                lane_ok)
+                return (k, v, c), (r, okf)
+
+            (keys, vals, count), (results, ok) = jax.lax.scan(
+                body, (keys, vals, count), (cmds, valid))
+            return keys, vals, count, results, ok
+
+        keys, vals, count, results, ok = jax.vmap(per_shard)(
+            sm_state["keys"], sm_state["vals"], sm_state["count"],
+            cmd_lanes, valid_mask)
+        return {"keys": keys, "vals": vals, "count": count}, (results, ok)
+
+    # -- reads -----------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _lookup_dev(self, keys_row, key):
+        slots = self._probe_slots(jnp.asarray(key, I32))
+        pk = keys_row[slots]
+        hit = (pk == key + 1) & (key >= 0)
+        return jnp.any(hit), slots[jnp.argmax(hit)]
+
+    def lookup(self, sm_state: dict, shard_slot: int, query: object):
+        """Host-callable point lookup (StaleRead analog)."""
+        key = int(query)  # type: ignore[arg-type]
+        found, slot = self._lookup_dev(sm_state["keys"][shard_slot], key)
+        if not bool(found):
+            return None
+        return int(sm_state["vals"][shard_slot, slot])

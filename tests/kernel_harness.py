@@ -80,7 +80,8 @@ class KernelCluster:
 
     def _route(self, out) -> None:
         """Scatter one step's outbound lanes into pending queues."""
-        o = {k: np.asarray(v) for k, v in out._asdict().items()}
+        o = {k: (np.asarray(v) if v is not None else None)
+             for k, v in out._asdict().items()}
         K, P_, E = self.kp.inbox_cap, self.kp.num_peers, self.kp.msg_entries
         for g in range(self.G):
             group = g // self.p
@@ -183,7 +184,8 @@ class KernelCluster:
         """One kernel step. proposals: {row: n_entries or [(is_cc)...]},
         reads: {row: (low, high)}, transfers: {row: target_rid}."""
         inp = empty_input(self.kp, self.G)
-        d = {k: np.asarray(v).copy() for k, v in inp._asdict().items()}
+        d = {k: (np.asarray(v).copy() if v is not None else None)
+             for k, v in inp._asdict().items()}
         if tick:
             d["tick"][:] = True
         if proposals:
@@ -206,8 +208,10 @@ class KernelCluster:
         from dragonboat_tpu.core.kstate import StepInput
 
         box = self._build_inbox()
-        self.state, out = step(self.kp, self.state, box,
-                               StepInput(**{k: np.asarray(v) for k, v in d.items()}))
+        self.state, out = step(
+            self.kp, self.state, box,
+            StepInput(**{k: (np.asarray(v) if v is not None else None)
+                         for k, v in d.items()}))
         self.last_out = out
         self._route(out)
         return out

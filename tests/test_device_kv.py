@@ -1,0 +1,126 @@
+"""DeviceKV — the device-native RSM (rsm/device_kv.py) and the fused
+propose→commit→apply bench pipeline (bench_loop.full_step_sm).
+
+Reference behavior matched: the in-memory KV RSM the reference's
+benchmarks apply (internal/tests/kvtest.go), re-expressed as a vmapped
+scatter-free hash-table kernel (BASELINE.json north star).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dragonboat_tpu.bench_loop import (
+    elect_all,
+    make_cluster,
+    make_device_sm,
+    run_steps_sm,
+    sm_params,
+)
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.rsm.device_kv import DeviceKV
+
+
+def test_put_get_update_roundtrip():
+    kv = DeviceKV(table_cap=64, probe_depth=8)
+    st = kv.init_state(2)
+    cmds = jnp.asarray([
+        [[5, 100], [9, 200], [5, 101], [0, 0]],    # shard 0: update key 5
+        [[7, 300], [7, 301], [7, 302], [1, 400]],  # shard 1
+    ], jnp.int32)
+    valid = jnp.asarray([[True, True, True, False],
+                         [True, True, True, True]])
+    st, (results, ok) = kv.apply_kernel(st, cmds, valid)
+    assert kv.lookup(st, 0, 5) == 101          # later write wins
+    assert kv.lookup(st, 0, 9) == 200
+    assert kv.lookup(st, 0, 0) is None         # invalid lane not applied
+    assert kv.lookup(st, 1, 7) == 302
+    assert kv.lookup(st, 1, 1) == 400
+    assert int(st["count"][0]) == 2 and int(st["count"][1]) == 2
+    okn = np.asarray(ok)
+    assert not okn[0, 3] and okn[0, :3].all()  # invalid lane not applied
+
+
+def test_collisions_probe_to_free_slots():
+    """Keys that hash to the same bucket must all land via probing."""
+    kv = DeviceKV(table_cap=16, probe_depth=16)
+    st = kv.init_state(1)
+    # 10 distinct keys into a 16-slot table: collisions guaranteed
+    keys = list(range(100, 110))
+    cmds = jnp.asarray([[[k, k * 7] for k in keys]], jnp.int32)
+    valid = jnp.ones((1, len(keys)), bool)
+    st, (results, ok) = kv.apply_kernel(st, cmds, valid)
+    for k in keys:
+        assert kv.lookup(st, 0, k) == k * 7, k
+    assert int(st["count"][0]) == len(keys)
+    assert np.asarray(ok).all()
+
+
+def test_full_probe_window_rejects():
+    kv = DeviceKV(table_cap=4, probe_depth=4)
+    st = kv.init_state(1)
+    cmds = jnp.asarray([[[k, k] for k in range(1, 9)]], jnp.int32)
+    valid = jnp.ones((1, 8), bool)
+    st, (results, ok) = kv.apply_kernel(st, cmds, valid)
+    assert (~np.asarray(ok)).any(), "an over-full table must reject writes"
+    assert int(st["count"][0]) <= 4
+
+
+def test_bench_pipeline_applies_to_device_kv():
+    """The fused pipeline: every committed write lands in the DeviceKV
+    with payload == entry index, on leaders AND followers — payloads
+    ride the replicated lv ring, so follower tables hold real values."""
+    kp = sm_params(3)
+    groups = 16
+    state = make_cluster(kp, groups, 3)
+    state, box = elect_all(kp, 3, state)
+    kv, kv_state = make_device_sm(groups, 3)
+    state, box, kv_state, rej = run_steps_sm(
+        kp, 3, kv, 12, True, True, state, box, kv_state)
+    # settle: no new proposals, so follower applied cursors catch up
+    state, box, kv_state, rej2 = run_steps_sm(
+        kp, 3, kv, 6, False, False, state, box, kv_state)
+    assert int(rej) == 0 and int(rej2) == 0, "committed writes rejected"
+    role = np.asarray(state.role)
+    applied = np.asarray(state.applied)
+    lv = np.asarray(state.lv)
+    snap = np.asarray(state.snap_index)
+    leaders = np.nonzero(role == KP.LEADER)[0]
+    assert len(leaders) == groups
+    checked = 0
+    for g in range(groups * 3):          # every replica, leader or not
+        hi = int(applied[g])
+        assert hi > 0, f"lane {g} never applied"
+        # the replicated payload ring holds the entry's own index
+        for idx in range(max(int(snap[g]) + 1, hi - 5), hi + 1):
+            assert lv[g, idx & (kp.log_cap - 1)] == idx, (g, idx)
+        # and the KV table's entry for a recent key matches
+        v = kv.lookup(kv_state, g, hi & (kv.table_cap // 2 - 1))
+        assert v is not None and \
+            v & (kv.table_cap // 2 - 1) == hi & (kv.table_cap // 2 - 1)
+        checked += 1
+    assert checked == groups * 3
+    # convergence oracle: all replicas of a group hold identical tables
+    keys = np.asarray(kv_state["keys"]).reshape(groups, 3, -1)
+    vals = np.asarray(kv_state["vals"]).reshape(groups, 3, -1)
+    same = 0
+    for n in range(groups):
+        a = np.asarray(applied).reshape(groups, 3)[n]
+        if a[0] == a[1] == a[2]:         # equal applied -> equal tables
+            for r in (1, 2):
+                assert (keys[n, 0] == keys[n, r]).all(), (n, r)
+                assert (vals[n, 0] == vals[n, r]).all(), (n, r)
+            same += 1
+    assert same >= 1
+
+
+def test_negative_keys_rejected():
+    kv = DeviceKV(table_cap=16, probe_depth=4)
+    st = kv.init_state(1)
+    cmds = jnp.asarray([[[-1, 42], [3, 7]]], jnp.int32)
+    st, (results, ok) = kv.apply_kernel(st, cmds, jnp.ones((1, 2), bool))
+    okn = np.asarray(ok)
+    assert not okn[0, 0] and okn[0, 1]
+    assert np.asarray(results)[0, 1] == 7
+    assert kv.lookup(st, 0, -1) is None
+    assert kv.lookup(st, 0, 3) == 7
+    assert int(st["count"][0]) == 1
