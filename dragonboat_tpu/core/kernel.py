@@ -538,10 +538,14 @@ def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
         lcc=jnp.where(slot_written, m.ent_cc[lane_of_slot], s.lcc),
     )
     if kp.inline_payloads:
-        m_val = (m.ent_val if m.ent_val is not None
-                 else jnp.zeros_like(m.ent_term))
+        # trace-time contract: a payload-carrying kernel must be fed
+        # payload lanes — substituting zeros would silently corrupt
+        # follower state machines after a failover
+        if m.ent_val is None:
+            raise ValueError(
+                "inline_payloads kernel requires Inbox.ent_val lanes")
         s = s._replace(
-            lv=jnp.where(slot_written, m_val[lane_of_slot], s.lv))
+            lv=jnp.where(slot_written, m.ent_val[lane_of_slot], s.lv))
     new_last_if_append = m.log_index + m.n_ent
     s = mrep(s, do_append, last=new_last_if_append,
              stable=jnp.minimum(s.stable, m.log_index + append_from_lane))

@@ -143,7 +143,10 @@ class IDeviceStateMachine(abc.ABC):
     @abc.abstractmethod
     def apply_kernel(self, sm_state: object, cmd_lanes: object,
                      valid_mask: object) -> tuple[object, object]:
-        """(new_state, results) — vmapped over shards by the engine."""
+        """(new_state, (results, ok)) — vmapped over shards by the
+        engine.  ``ok`` is a per-lane bool: False on a valid lane means
+        the SM rejected the command (results values are free-form, so
+        status must not be encoded in them)."""
 
     @abc.abstractmethod
     def lookup(self, sm_state: object, shard_slot: int, query: object) -> object: ...
