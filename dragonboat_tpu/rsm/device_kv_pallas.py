@@ -1,0 +1,148 @@
+"""Pallas apply kernel for DeviceKV — the rsm-apply hot loop as a real
+TPU kernel.
+
+Why pallas here: the XLA lowering of ``DeviceKV.apply_kernel`` is a
+``lax.scan`` over the AB command lanes, and every iteration streams the
+whole ``[G, T]`` table through HBM (AB x 2 full passes).  This kernel
+keeps an 8-shard block of the table resident in VMEM across the entire
+apply window — one HBM read + one write of the table per step instead of
+AB of each — while the per-command work stays VPU-shaped ([8, T]
+elementwise one-hot selects, no gathers/scatters, same discipline as the
+raft kernel).
+
+Semantics are bit-identical to the XLA path (same linear-probe order,
+same last-write-wins within a window); ``tests/test_device_kv_pallas.py``
+asserts exact state/result equality in interpret mode.  ``interpret=True``
+is forced on CPU (pallas TPU lowering needs the real backend); on TPU the
+compiled path runs — validation of the speedup is pending device access
+(the tunnel was down when this landed; see PERF.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from dragonboat_tpu.core.params import splitmix32
+from dragonboat_tpu.rsm.device_kv import DeviceKV
+
+I32 = jnp.int32
+SHARD_BLOCK = 8   # sublane dimension: shards per grid program
+
+
+def _apply_block_kernel(T: int, D: int, AB: int, hash_keys: bool,
+                        cmds_ref, valid_ref,
+                        _keys_in, _vals_in, _count_in,
+                        keys_ref, vals_ref, count_ref,
+                        results_ref, ok_ref):
+    """One grid program: apply AB commands to an [8, T] table block held
+    in VMEM.  keys/vals/count are input_output_aliased (in-place): the
+    output refs start holding the input tables, so the kernel reads and
+    writes through them and ignores the shadow input refs."""
+    pos = jax.lax.broadcasted_iota(I32, (SHARD_BLOCK, T), 1)
+
+    def body(j, _):
+        key = cmds_ref[:, j, 0]                       # [8]
+        val = cmds_ref[:, j, 1]
+        lane_ok = valid_ref[:, j] != 0
+        if hash_keys:
+            # the SAME mixer as DeviceKV._probe_slots — probe order must
+            # stay bit-identical between the pallas and XLA paths
+            h = splitmix32(key.astype(jnp.uint32)).astype(I32) & (T - 1)
+        else:
+            h = key & (T - 1)
+        rel = (pos - h[:, None]) & (T - 1)            # [8, T]
+        in_window = rel < D
+        K = keys_ref[:, :]                            # current table keys
+        hit = (K == key[:, None] + 1) & in_window
+        empty = (K == 0) & in_window
+        # first (lowest probe offset) hit, else first empty — identical
+        # pick order to the sequential XLA path
+        hit_rel = jnp.where(hit, rel, T)
+        empty_rel = jnp.where(empty, rel, T)
+        min_hit = jnp.min(hit_rel, axis=1)            # [8]
+        min_empty = jnp.min(empty_rel, axis=1)
+        use_rel = jnp.where(min_hit < T, min_hit, min_empty)
+        found = use_rel < T
+        do = lane_ok & found & (key >= 0)
+        is_new = do & ~(min_hit < T)
+        target = (h + use_rel) & (T - 1)              # [8]
+        onehot = (pos == target[:, None]) & do[:, None]
+        keys_ref[:, :] = jnp.where(onehot, key[:, None] + 1, K)
+        vals_ref[:, :] = jnp.where(onehot, val[:, None], vals_ref[:, :])
+        count_ref[:, 0] = count_ref[:, 0] + is_new.astype(I32)
+        results_ref[:, j] = jnp.where(do, val, -1)
+        ok_ref[:, j] = do.astype(I32)
+        return 0
+
+    jax.lax.fori_loop(0, AB, body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _apply_pallas(kv: DeviceKV, interpret: bool, keys, vals, count,
+                  cmd_lanes, valid_mask):
+    G = keys.shape[0]
+    T, D = kv.table_cap, kv.probe_depth
+    AB = cmd_lanes.shape[1]
+    pad = (-G) % SHARD_BLOCK
+    if pad:
+        keys = jnp.pad(keys, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        count = jnp.pad(count, (0, pad))
+        cmd_lanes = jnp.pad(cmd_lanes, ((0, pad), (0, 0), (0, 0)))
+        valid_mask = jnp.pad(valid_mask, ((0, pad), (0, 0)))
+    Gp = G + pad
+    grid = (Gp // SHARD_BLOCK,)
+
+    def block(i):  # shard-block index map
+        return (i, 0)
+
+    kernel = functools.partial(_apply_block_kernel, T, D, AB, kv.hash_keys)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((SHARD_BLOCK, AB, 2), lambda i: (i, 0, 0)),
+            pl.BlockSpec((SHARD_BLOCK, AB), block),
+            pl.BlockSpec((SHARD_BLOCK, T), block),
+            pl.BlockSpec((SHARD_BLOCK, T), block),
+            pl.BlockSpec((SHARD_BLOCK, 1), block),
+        ],
+        out_specs=[
+            pl.BlockSpec((SHARD_BLOCK, T), block),
+            pl.BlockSpec((SHARD_BLOCK, T), block),
+            pl.BlockSpec((SHARD_BLOCK, 1), block),
+            pl.BlockSpec((SHARD_BLOCK, AB), block),
+            pl.BlockSpec((SHARD_BLOCK, AB), block),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Gp, T), I32),       # keys
+            jax.ShapeDtypeStruct((Gp, T), I32),       # vals
+            jax.ShapeDtypeStruct((Gp, 1), I32),       # count
+            jax.ShapeDtypeStruct((Gp, AB), I32),      # results
+            jax.ShapeDtypeStruct((Gp, AB), I32),      # ok
+        ],
+        # tables update in place: alias inputs 2/3/4 onto outputs 0/1/2
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(cmd_lanes, valid_mask.astype(I32), keys, vals, count[:, None])
+    nkeys, nvals, ncount, results, ok = out
+    return (nkeys[:G], nvals[:G], ncount[:G, 0], results[:G],
+            ok[:G].astype(bool))
+
+
+def apply_kernel_pallas(kv: DeviceKV, sm_state: dict, cmd_lanes,
+                        valid_mask, interpret: bool | None = None):
+    """Drop-in replacement for ``DeviceKV.apply_kernel`` backed by the
+    pallas block kernel.  ``interpret`` defaults to True off-TPU."""
+    if interpret is None:
+        # compiled path on real TPU hardware; PJRT plugins may register
+        # the chip under another name (e.g. "axon"), so match both
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    keys, vals, count, results, ok = _apply_pallas(
+        kv, interpret, sm_state["keys"], sm_state["vals"],
+        sm_state["count"], cmd_lanes, valid_mask)
+    return {"keys": keys, "vals": vals, "count": count}, (results, ok)

@@ -143,6 +143,9 @@ def bench_rsm_step(quick):
                 pb.Entry(term=1, index=base + j,
                          client_id=(77 if sessions else 0),
                          series_id=((base + j) if sessions else 0),
+                         # real clients acknowledge as they go; keeps the
+                         # session response cache bounded
+                         responded_to=((base + j - 1) if sessions else 0),
                          cmd=b"key%d=val" % (j % 97))
                 for j in range(64)
             ]

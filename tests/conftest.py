@@ -24,7 +24,11 @@ import jax  # noqa: E402
 # that init blocks forever and would hang the whole suite)
 import jax._src.xla_bridge as _xb  # noqa: E402
 
-for _plat in ("axon", "tpu", "cuda", "rocm"):
+# pop ONLY the axon plugin: removing "tpu"/"cuda" from the factory map
+# also erases those names from jax's known-platform registry, which
+# breaks importing jax.experimental.pallas (its TPU lowering rules
+# register against the "tpu" platform name)
+for _plat in ("axon",):
     _xb._backend_factories.pop(_plat, None)
 
 # the ambient JAX_PLATFORMS=axon was latched when the sitecustomize imported
