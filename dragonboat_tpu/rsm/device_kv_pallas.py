@@ -81,7 +81,11 @@ def _apply_block_kernel(T: int, D: int, AB: int, hash_keys: bool,
     jax.lax.fori_loop(0, AB, body, 0)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
+# keys/vals/count are donated: callers replace their state dict with the
+# returned one, and without donation XLA must copy the whole table into
+# the aliased output buffers — re-adding the HBM traffic the kernel
+# exists to remove
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
 def _apply_pallas(kv: DeviceKV, interpret: bool, keys, vals, count,
                   cmd_lanes, valid_mask):
     G = keys.shape[0]

@@ -1,0 +1,41 @@
+"""The examples/ scripts must run end-to-end (a user's first contact)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=300):
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=os.path.join(REPO, "examples"),
+    )
+
+
+def test_helloworld():
+    r = _run("helloworld.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dublin (linearizable read via follower host): rain" in r.stdout
+
+
+def test_ondisk_two_runs(tmp_path):
+    r1 = _run("ondisk.py", str(tmp_path))
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert "wrote boot" in r1.stdout
+    r2 = _run("ondisk.py", str(tmp_path))
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "recovered from disk: boot =" in r2.stdout
+
+
+@pytest.mark.slow
+def test_multigroup_device():
+    r = _run("multigroup_device.py", timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "32/32 shards elected" in r.stdout
+    assert "wrote to 32/32 shards" in r.stdout
