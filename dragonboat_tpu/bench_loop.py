@@ -130,14 +130,12 @@ def sm_params(replicas: int = 3) -> KP.KernelParams:
 
 def make_device_sm(num_groups: int, replicas: int = 3,
                    table_cap: int = 1024):
-    """(DeviceKV, kv_state) sized for the bench cluster.  The key space
-    (table_cap/2 distinct keys) stays at load factor <= 0.5 so the probe
-    window never fills in steady state."""
+    """(DeviceKV, kv_state) sized for the bench cluster.  Direct-mapped:
+    the range apply writes key = index mod table_cap, so every slot is
+    that key's private home and no write can ever be rejected."""
     from dragonboat_tpu.rsm.device_kv import DeviceKV
 
     G = num_groups * replicas
-    # direct-mapped: the bench key space (table_cap/2 keys) is collision-
-    # free by construction, so NO committed write is ever rejected
     kv = DeviceKV(table_cap=table_cap, hash_keys=False)
     return kv, kv.init_state(G)
 
@@ -159,11 +157,18 @@ def full_step_sm(kp: KP.KernelParams, replicas: int, kv, state: ShardState,
     idx = out.apply_first[:, None] + jnp.arange(AB, dtype=jnp.int32)[None, :]
     valid = idx <= out.apply_last[:, None]                   # [G, AB]
     vals = jnp.take_along_axis(state.lv, idx & (CAP - 1), axis=1)
-    # half the table's slots as key space: load factor <= 0.5, so probe
-    # windows do not fill up and reject committed writes
-    keys = idx & (kv.table_cap // 2 - 1)
-    cmds = jnp.stack([keys, vals], axis=-1)                  # [G, AB, 2]
-    kv_state, (_results, ok) = kv.apply_kernel(kv_state, cmds, valid)
+    if not kv.hash_keys:
+        # raft applies a CONTIGUOUS window: one-pass range apply, no
+        # serial B-iteration scan (keys = index mod table_cap)
+        first_key = out.apply_first & (kv.table_cap - 1)
+        kv_state, (_results, ok) = kv.apply_kernel_range(
+            kv_state, first_key, vals, valid)
+    else:
+        # hashed tables: probing scan; half the table as key space keeps
+        # load <= 0.5 so probe windows don't fill and reject
+        keys = idx & (kv.table_cap // 2 - 1)
+        cmds = jnp.stack([keys, vals], axis=-1)              # [G, AB, 2]
+        kv_state, (_results, ok) = kv.apply_kernel(kv_state, cmds, valid)
     # a rejected committed write must be surfaced, not swallowed —
     # the bench reports the count
     n_rejected = jnp.sum(~ok & valid)

@@ -124,6 +124,39 @@ class DeviceKV(IDeviceStateMachine):
             cmd_lanes, valid_mask)
         return {"keys": keys, "vals": vals, "count": count}, (results, ok)
 
+    def apply_kernel_range(self, sm_state: dict, first_key, vals, valid_mask):
+        """One-pass apply of a CONTIGUOUS key window to a direct-mapped
+        table — the natural shape of raft apply (a consecutive log window
+        landing in an array-backed SM).  Lane j writes key
+        ``(first_key + j) & (table_cap - 1)`` with ``vals[:, j]``; with
+        window width <= table_cap the keys are distinct, so the whole
+        ``[G, B]`` window lands in one vectorized pass (each table slot
+        GATHERS its lane — the same scatter-free trick as the raft
+        kernel's replicate append) instead of B serial iterations.
+
+        Bit-identical to ``apply_kernel`` driven with the same
+        (key, value) lanes on a ``hash_keys=False`` table."""
+        assert not self.hash_keys, "range apply requires hash_keys=False"
+        T = self.table_cap
+        B = vals.shape[1]
+        assert B <= T, "window wider than the table aliases keys"
+        slots = jnp.arange(T, dtype=I32)[None, :]            # [1, T]
+        rel = (slots - first_key[:, None]) & (T - 1)         # [G, T]
+        lane_of_slot = jnp.minimum(rel, B - 1)
+        lane_valid = jnp.take_along_axis(
+            valid_mask.astype(I32), lane_of_slot, axis=1).astype(bool)
+        written = (rel < B) & lane_valid                     # [G, T]
+        new_vals = jnp.take_along_axis(vals, lane_of_slot, axis=1)
+        was_empty = sm_state["keys"] == 0
+        key_of_slot = (first_key[:, None] + rel) & (T - 1)   # == slots
+        out_keys = jnp.where(written, key_of_slot + 1, sm_state["keys"])
+        out_vals = jnp.where(written, new_vals, sm_state["vals"])
+        count = sm_state["count"] + jnp.sum(
+            (written & was_empty).astype(I32), axis=-1)
+        results = jnp.where(valid_mask, vals, -1)
+        return ({"keys": out_keys, "vals": out_vals, "count": count},
+                (results, valid_mask))
+
     # -- reads -----------------------------------------------------------
 
     @functools.partial(jax.jit, static_argnums=0)

@@ -140,8 +140,14 @@ def _apply_pallas(kv: DeviceKV, interpret: bool, keys, vals, count,
 
 def apply_kernel_pallas(kv: DeviceKV, sm_state: dict, cmd_lanes,
                         valid_mask, interpret: bool | None = None):
-    """Drop-in replacement for ``DeviceKV.apply_kernel`` backed by the
-    pallas block kernel.  ``interpret`` defaults to True off-TPU."""
+    """``DeviceKV.apply_kernel`` semantics backed by the pallas block
+    kernel.  NOT drop-in on buffer lifetime: the input state arrays are
+    DONATED (callers must replace their state dict with the returned one
+    and never touch the old arrays — keeping a pre-apply copy requires
+    an explicit ``jnp.copy`` first).  Donation is what lets the aliased
+    tables update in place; with ``G`` not a multiple of SHARD_BLOCK the
+    pad path copies anyway, so size ``G`` block-aligned for the zero-copy
+    claim to hold.  ``interpret`` defaults to True off-TPU."""
     if interpret is None:
         # compiled path on real TPU hardware; PJRT plugins may register
         # the chip under another name (e.g. "axon"), so match both

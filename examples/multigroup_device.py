@@ -53,7 +53,13 @@ def main() -> int:
             except (RequestDroppedError, RequestTimeoutError):
                 time.sleep(0.05)
     print(f"wrote to {wrote}/32 shards through one batched kernel")
-    print("shard 17 reads:", nh.sync_read(17, "shard"))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            print("shard 17 reads:", nh.sync_read(17, "shard"))
+            break
+        except (RequestDroppedError, RequestTimeoutError):
+            time.sleep(0.05)  # transient right after elections; retry
     nh.close()
     return 0
 

@@ -94,9 +94,9 @@ def test_bench_pipeline_applies_to_device_kv():
         for idx in range(max(int(snap[g]) + 1, hi - 5), hi + 1):
             assert lv[g, idx & (kp.log_cap - 1)] == idx, (g, idx)
         # and the KV table's entry for a recent key matches
-        v = kv.lookup(kv_state, g, hi & (kv.table_cap // 2 - 1))
+        v = kv.lookup(kv_state, g, hi & (kv.table_cap - 1))
         assert v is not None and \
-            v & (kv.table_cap // 2 - 1) == hi & (kv.table_cap // 2 - 1)
+            v & (kv.table_cap - 1) == hi & (kv.table_cap - 1)
         checked += 1
     assert checked == groups * 3
     # convergence oracle: all replicas of a group hold identical tables
@@ -124,3 +124,46 @@ def test_negative_keys_rejected():
     assert kv.lookup(st, 0, -1) is None
     assert kv.lookup(st, 0, 3) == 7
     assert int(st["count"][0]) == 1
+
+
+def test_range_apply_matches_sequential():
+    """apply_kernel_range must be bit-identical to the probing scan fed
+    the same contiguous (key, value) lanes on a direct-mapped table."""
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    kv = DeviceKV(table_cap=64, probe_depth=8, hash_keys=False)
+    G, B = 7, 16
+    st_a = kv.init_state(G)
+    st_b = kv.init_state(G)
+    first = np.zeros(G, np.int64)
+    for _ in range(5):
+        vals = rng.integers(0, 1000, size=(G, B), dtype=np.int32)
+        valid = jnp.asarray(rng.random((G, B)) < 0.8)
+        keys = ((first[:, None] + np.arange(B)) & (kv.table_cap - 1)
+                ).astype(np.int32)
+        cmds = jnp.asarray(np.stack([keys, vals], axis=-1))
+        st_a, (ra, oka) = kv.apply_kernel(st_a, cmds, valid)
+        st_b, (rb, okb) = kv.apply_kernel_range(
+            st_b, jnp.asarray(first & (kv.table_cap - 1), jnp.int32),
+            jnp.asarray(vals), valid)
+        for f in ("keys", "vals", "count"):
+            assert (np.asarray(st_a[f]) == np.asarray(st_b[f])).all(), f
+        assert (np.asarray(ra) == np.asarray(rb)).all()
+        assert (np.asarray(oka) == np.asarray(okb)).all()
+        first += rng.integers(0, B + 1, size=G)  # windows advance unevenly
+
+
+def test_range_apply_wraps_and_counts():
+    kv = DeviceKV(table_cap=16, hash_keys=False)
+    st = kv.init_state(1)
+    # window of 8 starting at 12: wraps to slots 12..15, 0..3
+    vals = jnp.asarray([[100, 101, 102, 103, 104, 105, 106, 107]], jnp.int32)
+    st, (r, ok) = kv.apply_kernel_range(
+        st, jnp.asarray([12], jnp.int32), vals, jnp.ones((1, 8), bool))
+    import numpy as np
+
+    assert np.asarray(ok).all()
+    for j in range(8):
+        assert kv.lookup(st, 0, (12 + j) & 15) == 100 + j
+    assert int(st["count"][0]) == 8
