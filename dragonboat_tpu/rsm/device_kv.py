@@ -148,8 +148,8 @@ class DeviceKV(IDeviceStateMachine):
         written = (rel < B) & lane_valid                     # [G, T]
         new_vals = jnp.take_along_axis(vals, lane_of_slot, axis=1)
         was_empty = sm_state["keys"] == 0
-        key_of_slot = (first_key[:, None] + rel) & (T - 1)   # == slots
-        out_keys = jnp.where(written, key_of_slot + 1, sm_state["keys"])
+        # a direct-mapped slot's key IS the slot index
+        out_keys = jnp.where(written, slots + 1, sm_state["keys"])
         out_vals = jnp.where(written, new_vals, sm_state["vals"])
         count = sm_state["count"] + jnp.sum(
             (written & was_empty).astype(I32), axis=-1)

@@ -54,12 +54,15 @@ def main() -> int:
                 time.sleep(0.05)
     print(f"wrote to {wrote}/32 shards through one batched kernel")
     deadline = time.time() + 30
+    read_value = None
     while time.time() < deadline:
         try:
-            print("shard 17 reads:", nh.sync_read(17, "shard"))
+            read_value = nh.sync_read(17, "shard")
             break
         except (RequestDroppedError, RequestTimeoutError):
             time.sleep(0.05)  # transient right after elections; retry
+    assert read_value is not None, "shard 17 never served the read"
+    print("shard 17 reads:", read_value)
     nh.close()
     return 0
 

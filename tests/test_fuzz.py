@@ -1,0 +1,173 @@
+"""Decode-robustness fuzzing — the pytest analog of the reference's
+go-fuzz entry points (raftpb/fuzz.go, internal/transport/fuzz.go).
+
+Property: hostile bytes fed to any wire decoder must raise a controlled
+ValueError/struct.error-style exception (or return a valid object) —
+never crash the process, hang, or raise something uncontrolled like
+MemoryError from a hostile length field.
+"""
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.logdb.tan import TanLogDB
+from dragonboat_tpu.rsm.snapshotio import SnapshotFormatError, read_snapshot
+
+OK_ERRORS = (ValueError, struct.error, IndexError, OverflowError,
+             UnicodeDecodeError, EOFError)
+# deliberately NOT in OK_ERRORS: MemoryError — a decoder that trusts a
+# hostile length field into a giant allocation is exactly the bug class
+# these tests exist to catch.
+
+
+def _rng():
+    return np.random.default_rng(0xDB)
+
+
+def test_fuzz_message_batch_random_bytes():
+    rng = _rng()
+    for n in (0, 1, 3, 4, 16, 64, 256, 4096):
+        for _ in range(50):
+            blob = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            with pytest.raises(OK_ERRORS):
+                pb.decode_message_batch(blob)
+
+
+def test_fuzz_message_batch_bitflips():
+    """Valid frame, single bit flipped anywhere -> checksum catches it
+    (or the decode still yields a well-formed batch iff the flip landed
+    after the CRC gate's coverage — it can't: the CRC covers the body)."""
+    msgs = tuple(
+        pb.Message(type=pb.MessageType.REPLICATE, from_=1, to=2, shard_id=9,
+                   term=4, log_index=i,
+                   entries=(pb.Entry(term=4, index=i + 1, cmd=b"pay" * 5),))
+        for i in range(8)
+    )
+    enc = pb.encode_message_batch(pb.MessageBatch(
+        requests=msgs, deployment_id=3, source_address="fz-1"))
+    rng = _rng()
+    for _ in range(300):
+        i = int(rng.integers(0, len(enc)))
+        bit = 1 << int(rng.integers(0, 8))
+        mutated = bytearray(enc)
+        mutated[i] ^= bit
+        with pytest.raises(ValueError):
+            pb.decode_message_batch(bytes(mutated))
+
+
+def test_fuzz_message_batch_truncations():
+    msgs = (pb.Message(type=pb.MessageType.HEARTBEAT, from_=1, to=2,
+                       shard_id=1, term=1),)
+    enc = pb.encode_message_batch(pb.MessageBatch(
+        requests=msgs, deployment_id=0, source_address="fz-2"))
+    for cut in range(len(enc)):
+        with pytest.raises(OK_ERRORS):
+            pb.decode_message_batch(enc[:cut])
+
+
+def test_fuzz_hostile_length_fields_do_not_allocate():
+    """A frame with a VALID body CRC but a hostile element count must be
+    rejected by running off the buffer end — never trusted into a giant
+    pre-allocation (MemoryError is not an accepted outcome)."""
+    body = struct.pack("<QII", 1, 1, 4) + b"addr" + struct.pack("<I", 1 << 31)
+    blob = struct.pack("<I", zlib.crc32(body)) + body
+    with pytest.raises(OK_ERRORS):
+        pb.decode_message_batch(blob)
+
+
+def test_fuzz_tan_log_random_and_mutated(tmp_path):
+    """Random garbage and bit-flipped tan logs must either replay the
+    valid prefix (torn tail) or raise CorruptLogError — never crash."""
+    from dragonboat_tpu.logdb.tan import CorruptLogError
+
+    rng = _rng()
+    # a valid log to mutate
+    d1 = tmp_path / "base"
+    db = TanLogDB(str(d1))
+    for i in range(1, 20):
+        db.save_raft_state([pb.Update(
+            shard_id=1, replica_id=1,
+            state=pb.State(term=1, vote=1, commit=i),
+            entries_to_save=(pb.Entry(term=1, index=i, cmd=b"z" * 24),),
+        )], 0)
+    db.close()
+    log_path = next(iter(sorted(d1.iterdir())))  # the single log file
+    raw = log_path.read_bytes()
+
+    for trial in range(40):
+        mutated = bytearray(raw)
+        for _ in range(int(rng.integers(1, 4))):
+            mutated[int(rng.integers(0, len(mutated)))] ^= \
+                1 << int(rng.integers(0, 8))
+        d = tmp_path / f"m{trial}"
+        d.mkdir()
+        (d / log_path.name).write_bytes(bytes(mutated))
+        try:
+            db2 = TanLogDB(str(d))
+            # whatever replayed must be internally consistent
+            for info in db2.list_node_info():
+                rs = db2.read_raft_state(info.shard_id, info.replica_id, 0)
+                if rs is not None:
+                    assert rs.entry_count >= 0
+            db2.close()
+        except CorruptLogError:
+            pass  # controlled refusal is the other valid outcome
+
+    for trial in range(20):
+        d = tmp_path / f"r{trial}"
+        d.mkdir()
+        blob = rng.integers(0, 256, size=int(rng.integers(0, 2000)),
+                            dtype=np.uint8).tobytes()
+        (d / "log-00000001.tan").write_bytes(blob)
+        try:
+            TanLogDB(str(d)).close()
+        except CorruptLogError:
+            pass
+
+
+def test_fuzz_snapshot_reader(tmp_path):
+    rng = _rng()
+    for trial in range(60):
+        blob = rng.integers(0, 256, size=int(rng.integers(0, 500)),
+                            dtype=np.uint8).tobytes()
+        p = tmp_path / f"s{trial}.gbsnap"
+        p.write_bytes(blob)
+        with open(p, "rb") as f:
+            with pytest.raises((SnapshotFormatError, *OK_ERRORS)):
+                session, payload = read_snapshot(f)
+                payload.read()
+
+
+def test_fuzz_chunk_sink_hostile_chunks(tmp_path):
+    """Hostile chunk sequences must never crash the sink or leak
+    transfers (out-of-order ids, bogus counts, wrong deployment)."""
+    from dragonboat_tpu.transport.chunks import ChunkSink
+
+    delivered = []
+    sink = ChunkSink(str(tmp_path), deployment_id=5,
+                     deliver=lambda m, s: delivered.append(m))
+    rng = _rng()
+    for _ in range(300):
+        c = pb.Chunk(
+            shard_id=int(rng.integers(0, 3)),
+            replica_id=int(rng.integers(0, 3)),
+            from_=int(rng.integers(0, 3)),
+            chunk_id=int(rng.integers(0, 5)),
+            chunk_count=int(rng.integers(0, 5)),
+            chunk_size=0,
+            file_size=int(rng.integers(0, 100)),
+            index=1, term=1,
+            deployment_id=int(rng.integers(4, 7)),
+            data=bytes(rng.integers(0, 256, size=int(rng.integers(0, 64)),
+                                    dtype=np.uint8)),
+            message=pb.Message(type=pb.MessageType.INSTALL_SNAPSHOT,
+                               from_=1, to=2, shard_id=1)
+            if rng.random() < 0.5 else None,
+        )
+        sink.add(c)  # bool result; must simply not raise
+    sink.tick()
+    assert sink.inflight() <= 9  # bounded by (shard, replica, from) keys
