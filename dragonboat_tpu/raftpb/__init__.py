@@ -10,7 +10,9 @@ TPU-native re-expression of the reference's ``raftpb`` package
    (``Entry.cmd``, membership maps, snapshots) that never live on device.
 2. **Device lanes** (``dragonboat_tpu.core``): fixed-width SoA arrays holding
    the subset of fields the batched Raft kernel needs (terms, indexes,
-   cursors, flow-control state).  ``core.msgpack`` converts between the two.
+   cursors, flow-control state).  The kernel engine's staging buffers
+   (``engine.kernel_engine._InboxBuilder`` / ``_InputBuilder``) and the
+   device router (``core.router``) convert between the two.
 
 Enum values mirror the reference exactly (``raftpb/types.go:8-215``) so that
 recorded histories, golden tests, and host interop stay comparable.
