@@ -91,7 +91,8 @@ def run_bench() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     platform = jax.devices()[0].platform
-    groups = int(os.environ.get("BENCH_GROUPS", "8192"))
+    default_groups = "8192" if platform != "cpu" else "1024"
+    groups = int(os.environ.get("BENCH_GROUPS", default_groups))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
     # a TPU device error at one scale (watchdog on long launches, or a
     # wedged tunnel mid-run) must not cost the whole record: retry the

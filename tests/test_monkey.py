@@ -24,14 +24,16 @@ class HashKV(KVStateMachine):
         return zlib.crc32(data.encode())
 
 
-def _mk(prefix, rtt_ms=5):
+def _mk(prefix, rtt_ms=5, expert=None, device_resident=False):
     addrs = {i: f"{prefix}-{i}" for i in (1, 2, 3)}
     hosts = {}
     for rid, addr in addrs.items():
+        kw = {"expert": expert} if expert is not None else {}
         nh = NodeHost(NodeHostConfig(raft_address=addr,
-                                     rtt_millisecond=rtt_ms))
+                                     rtt_millisecond=rtt_ms, **kw))
         nh.start_replica(addrs, False, HashKV, Config(
-            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1))
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            device_resident=device_resident))
         hosts[rid] = nh
     return hosts
 
@@ -86,3 +88,91 @@ def test_delay_and_reorder_hooks_preserve_safety():
     finally:
         for h in hosts.values():
             h.close()
+
+
+def test_kernel_engine_partition_linearizable():
+    """Chaos on the DEVICE path: 3 hosts run the shard as kernel lanes;
+    concurrent clients run through a leader-host partition + heal, and
+    the recorded history must be linearizable (docs/test.md monkey
+    assertion, here over the batched kernel engine)."""
+    import threading
+
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.history import HistoryRecorder, check_linearizable_kv
+
+    hosts = _mk(f"mk{time.monotonic_ns()}",
+                expert=ExpertConfig(kernel_log_cap=256, kernel_capacity=8),
+                device_resident=True)
+    h = HistoryRecorder()
+    stop = threading.Event()
+
+    def client(pid: int) -> None:
+        rng = random.Random(pid)
+        seq = 0
+        while not stop.is_set():
+            lid = None
+            rids = list(hosts)
+            rng.shuffle(rids)  # don't pin every client to a partitioned
+            # old leader that still believes in itself
+            for rid in rids:
+                nh = hosts[rid]
+                if nh._partitioned:
+                    continue  # this client can see the machine is gone
+                got, ok = nh.get_leader_id(1)
+                if ok and got in hosts and not hosts[got]._partitioned:
+                    lid = got
+                    break
+            if lid is None:
+                time.sleep(0.02)
+                continue
+            nh = hosts[lid]
+            try:
+                if pid % 2 == 0:
+                    val = f"p{pid}s{seq}"
+                    seq += 1
+                    rec = h.invoke(pid, "write", "x", val)
+                    try:
+                        nh.sync_propose(nh.get_noop_session(1),
+                                        f"x={val}".encode(), timeout_s=1.0)
+                        h.complete(rec)
+                    except Exception:
+                        pass  # open op: outcome unknown
+                else:
+                    rec = h.invoke(pid, "read", "x")
+                    try:
+                        h.complete(rec, value=nh.sync_read(1, "x",
+                                                           timeout_s=1.0))
+                    except Exception:
+                        pass
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(p,), daemon=True)
+               for p in range(4)]
+    try:
+        assert all(nh.nodes[1].peer is None for nh in hosts.values()), \
+            "shards must be device-resident"
+        lid = wait_leader(hosts, timeout=60)  # first kernel compile is slow
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        lid = wait_leader(hosts, timeout=30)
+        hosts[lid].partition_node()
+        time.sleep(2.0)
+        hosts[lid].restore_partitioned_node()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        completed = [o for o in h.ops if o.ret is not None]
+        assert len(completed) >= 10, "history too thin to mean anything"
+        assert check_linearizable_kv(h.ops), \
+            "linearizability violation on the kernel-engine path"
+    finally:
+        stop.set()
+        for t in threads:
+            if t.ident is not None:  # only join threads that started
+                t.join(timeout=5)
+        for nh in hosts.values():
+            nh.close()
