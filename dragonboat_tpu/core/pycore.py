@@ -228,6 +228,12 @@ class CoreConfig:
     is_witness: bool = False
     quiesce: bool = False
     max_entry_size: int = MAX_ENTRY_SIZE
+    # count cap per replicate message (the kernel's fixed E entry lanes);
+    # None = byte cap only.  The differential harness sets this to the
+    # kernel's msg_entries so catch-up proceeds in lockstep — otherwise a
+    # lagging follower refills at different rates on the two engines and
+    # an election mid-catch-up diverges (found by the seed soak)
+    max_entries_per_msg: int | None = None
 
 
 class Raft:
@@ -469,6 +475,8 @@ class Raft:
     def make_replicate_message(self, to: int, next_: int, max_size: int) -> pb.Message:
         term = self.log.term(next_ - 1)  # raises CompactedError when gone
         entries = self.log.entries_from(next_, max_size)
+        if self.cfg.max_entries_per_msg is not None:
+            entries = entries[: self.cfg.max_entries_per_msg]
         if to in self.witnesses:
             # witnesses receive metadata-only entries (raft.go:770 makeMetadataEntries)
             entries = [

@@ -153,12 +153,13 @@ def test_kernel_engine_partition_linearizable():
     try:
         assert all(nh.nodes[1].peer is None for nh in hosts.values()), \
             "shards must be device-resident"
-        lid = wait_leader(hosts, timeout=60)  # first kernel compile is slow
+        wait_leader(hosts, timeout=60)  # warmup: first kernel compile is slow
         for t in threads:
             t.start()
         time.sleep(2.0)
         lid = wait_leader(hosts, timeout=30)
         hosts[lid].partition_node()
+        partition_at = time.monotonic()
         time.sleep(2.0)
         hosts[lid].restore_partitioned_node()
         time.sleep(1.5)
@@ -167,6 +168,11 @@ def test_kernel_engine_partition_linearizable():
             t.join(timeout=5)
         completed = [o for o in h.ops if o.ret is not None]
         assert len(completed) >= 10, "history too thin to mean anything"
+        # the check must certify ops that SPAN the chaos window, not just
+        # steady state: require completions invoked after the partition
+        chaos_ops = [o for o in completed if o.call >= partition_at]
+        assert len(chaos_ops) >= 3, \
+            f"only {len(chaos_ops)} completed ops overlap the chaos window"
         assert check_linearizable_kv(h.ops), \
             "linearizability violation on the kernel-engine path"
     finally:
