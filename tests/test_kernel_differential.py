@@ -169,6 +169,54 @@ class DiffCluster:
         for _ in range(steps):
             self.step()
 
+    def _sigs(self):
+        kc = self.kc
+        sig_k = (tuple(int(x) for x in kc.field("term")),
+                 tuple(int(x) for x in kc.field("committed")),
+                 tuple(int(x) for x in kc.field("last")))
+        sig_p = (tuple(r.term for r in self.pm.rafts),
+                 tuple(r.log.committed for r in self.pm.rafts),
+                 tuple(r.log.last_index() for r in self.pm.rafts))
+        return sig_k, sig_p
+
+    def settle(self, max_cycles=12):
+        """Tick+drain until both engines reach a stable COMMON signature.
+
+        The kernel coalesces sends (<=1 replicate per peer per step,
+        kernel.py header) while pycore sends per-trigger, so CATCH-UP
+        TRAJECTORIES legitimately differ in pacing; the differential
+        invariant is the CONVERGED state.  A fixed drain window can
+        snapshot the two engines mid-catch-up (soak seed 172) — settle
+        until their scalar signatures match and stop moving."""
+        prev = None
+        for _ in range(max_cycles):
+            self.run_ticks(6)
+            self.drain(12)
+            sig_k, sig_p = self._sigs()
+            if sig_k == sig_p and sig_k == prev:
+                return
+            prev = sig_k
+        # fall through: compare() reports the precise field that differs
+
+    def settle_each(self, max_cycles=60):
+        """Tick+drain until EACH engine's own signature stops moving —
+        for chaos schedules where the engines ride different (both
+        correct) trajectories and never become bitwise equal.  A healed
+        cluster can take many election rounds to re-stabilize when a
+        formerly isolated replica rejoins as a disruptive higher-term
+        candidate (the classic scenario pre-vote exists to soften), so
+        the cycle budget is generous."""
+        prev = None
+        stable = 0
+        for _ in range(max_cycles):
+            self.run_ticks(6)
+            self.drain(12)
+            sig = self._sigs()
+            stable = stable + 1 if sig == prev else 0
+            if stable >= 3:  # quiet for 3 consecutive cycles
+                return
+            prev = sig
+
     def run_ticks(self, n: int) -> None:
         for _ in range(n):
             self.step(tick=True)
@@ -337,38 +385,99 @@ def test_diff_check_quorum_step_down():
     assert d.kc.leader_row(0) is None
 
 
-@pytest.mark.parametrize("seed", [7, 23, 106, 109, 1009])
+def _random_schedule(d, rng, step_no, partitions: bool):
+    ev = rng.random()
+    if ev < 0.55:
+        d.step(tick=True)
+    elif ev < 0.75:
+        props = {}
+        for g in range(d.groups):
+            lr = d.kc.leader_row(g)
+            if lr is not None:
+                props[lr] = int(rng.integers(1, 4))
+        d.step(tick=bool(rng.random() < 0.5), proposals=props)
+    elif ev < 0.85 or not partitions:
+        reads = {}
+        for g in range(d.groups):
+            lr = d.kc.leader_row(g)
+            if lr is not None:
+                reads[lr] = (step_no, g)
+        d.step(reads=reads)
+    elif ev < 0.95 and not d.kc.isolated:
+        d.isolate(int(rng.integers(0, d.kc.G)))
+        d.step(tick=True)
+    else:
+        d.heal()
+        d.step(tick=True)
+
+
+@pytest.mark.parametrize("seed", [7, 23, 106, 109, 172, 1009, 2024])
 def test_diff_randomized_trace(seed):
     """300-step seeded random schedule: ticks, proposal bursts on current
-    leaders, reads, short partitions.  Converged state must match exactly."""
+    leaders, reads.  PARTITION-FREE, so the two engines stay in exact
+    lockstep (no catch-up windows) and converged state must match
+    bitwise.  Partitioned schedules go through
+    test_chaos_randomized_safety instead: the kernel's documented
+    coalesced flow control (<=1 replicate per peer per step) paces
+    partition recovery differently from pycore's per-trigger sends, and
+    an election during a pacing-divergent catch-up window can
+    legitimately resolve differently — both trajectories are correct
+    raft, so bitwise equality is not an invariant there (the 80-seed
+    soak demonstrated exactly this)."""
     rng = np.random.default_rng(seed)
     d = DiffCluster(groups=2, replicas=3)
     d.tick_until_leader()
     for step_no in range(300):
-        ev = rng.random()
-        if ev < 0.55:
-            d.step(tick=True)
-        elif ev < 0.75:
-            props = {}
-            for g in range(d.groups):
-                lr = d.kc.leader_row(g)
-                if lr is not None:
-                    props[lr] = int(rng.integers(1, 4))
-            d.step(tick=bool(rng.random() < 0.5), proposals=props)
-        elif ev < 0.85:
-            reads = {}
-            for g in range(d.groups):
-                lr = d.kc.leader_row(g)
-                if lr is not None:
-                    reads[lr] = (step_no, g)
-            d.step(reads=reads)
-        elif ev < 0.95 and not d.kc.isolated:
-            d.isolate(int(rng.integers(0, d.kc.G)))
-            d.step(tick=True)
-        else:
-            d.heal()
-            d.step(tick=True)
-    d.heal()
-    d.run_ticks(12)
-    d.drain(16)
+        _random_schedule(d, rng, step_no, partitions=False)
+    d.settle()
     d.compare("random-trace")
+
+
+@pytest.mark.parametrize("seed", [106, 172, 307, 2024, 9090])
+def test_chaos_randomized_safety(seed):
+    """Randomized schedule WITH partitions: each engine is a correct raft
+    cluster on a (possibly diverging) trajectory, so the assertion is
+    RAFT SAFETY per engine after heal+settle — one leader per group,
+    replicas of a group hold identical logs, commit within bounds —
+    the monkey-harness convergence discipline (docs/test.md) applied to
+    both engines rather than bitwise cross-engine equality."""
+    rng = np.random.default_rng(seed)
+    d = DiffCluster(groups=2, replicas=3)
+    d.tick_until_leader()
+    for step_no in range(300):
+        _random_schedule(d, rng, step_no, partitions=True)
+    d.heal()
+    d.settle_each()
+    kc = d.kc
+    role = kc.field("role")
+    term = kc.field("term")
+    last = kc.field("last")
+    committed = kc.field("committed")
+    snap = kc.field("snap_index")
+    lt = kc.field("lt")
+    CAP = kc.kp.log_cap
+    for g in range(d.groups):
+        rows = list(range(g * 3, g * 3 + 3))
+        # exactly one leader, all replicas on its term
+        leaders = [r for r in rows if int(role[r]) == KP.LEADER]
+        assert len(leaders) == 1, f"group {g}: leaders {leaders}"
+        assert len({int(term[r]) for r in rows}) == 1, f"group {g} terms"
+        # replicas converged to identical logs and commit
+        assert len({int(last[r]) for r in rows}) == 1, f"group {g} last"
+        assert len({int(committed[r]) for r in rows}) == 1, f"group {g}"
+        lo = max(int(snap[r]) for r in rows) + 1
+        hi = int(last[rows[0]])
+        for i in range(lo, hi + 1):
+            ts = {int(lt[r, i & (CAP - 1)]) for r in rows}
+            assert len(ts) == 1, f"group {g} log[{i}] terms {ts}"
+        assert 0 < int(committed[rows[0]]) <= hi
+    # pycore side: same safety on its own trajectory
+    for g in range(d.groups):
+        rafts = [d.pm.rafts[r] for r in range(g * 3, g * 3 + 3)]
+        assert sum(r.is_leader() for r in rafts) == 1
+        assert len({r.term for r in rafts}) == 1
+        assert len({r.log.last_index() for r in rafts}) == 1
+        assert len({r.log.committed for r in rafts}) == 1
+        hi = rafts[0].log.last_index()
+        for i in range(1, hi + 1):
+            assert len({r.log.term(i) for r in rafts}) == 1, (g, i)

@@ -161,6 +161,7 @@ def test_kernel_engine_partition_linearizable():
         hosts[lid].partition_node()
         partition_at = time.monotonic()
         time.sleep(2.0)
+        heal_at = time.monotonic()
         hosts[lid].restore_partitioned_node()
         time.sleep(1.5)
         stop.set()
@@ -169,8 +170,10 @@ def test_kernel_engine_partition_linearizable():
         completed = [o for o in h.ops if o.ret is not None]
         assert len(completed) >= 10, "history too thin to mean anything"
         # the check must certify ops that SPAN the chaos window, not just
-        # steady state: require completions invoked after the partition
-        chaos_ops = [o for o in completed if o.call >= partition_at]
+        # steady state: require ops whose [call, ret] interval intersects
+        # the partition window itself (post-heal ops don't count)
+        chaos_ops = [o for o in completed
+                     if o.call < heal_at and o.ret > partition_at]
         assert len(chaos_ops) >= 3, \
             f"only {len(chaos_ops)} completed ops overlap the chaos window"
         assert check_linearizable_kv(h.ops), \
