@@ -433,6 +433,25 @@ def test_diff_randomized_trace(seed):
     d.compare("random-trace")
 
 
+@pytest.mark.parametrize("cfg", [
+    {"pre_vote": True},
+    {"check_quorum": True},
+    {"pre_vote": True, "check_quorum": True},
+])
+@pytest.mark.parametrize("seed", [11, 15])
+def test_diff_randomized_trace_configs(seed, cfg):
+    """The partition-free lockstep family under the pre-vote /
+    check-quorum config variants — the drop_rv lease and pre-vote
+    campaign paths under randomized schedules."""
+    rng = np.random.default_rng(seed)
+    d = DiffCluster(groups=2, replicas=3, **cfg)
+    d.tick_until_leader()
+    for step_no in range(300):
+        _random_schedule(d, rng, step_no, partitions=False)
+    d.settle()
+    d.compare(f"random-trace {cfg}")
+
+
 @pytest.mark.parametrize("seed", [106, 172, 307, 2024, 9090])
 def test_chaos_randomized_safety(seed):
     """Randomized schedule WITH partitions: each engine is a correct raft
