@@ -29,6 +29,8 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
+from dragonboat_tpu.hostenv import clean_cpu_env, probe_devices  # noqa: E402
+
 BASELINE_WPS = 9e6
 
 
@@ -46,34 +48,8 @@ def fail(stage: str, err: str) -> None:
     })
 
 
-def probe_backend(timeout_s: float) -> str | None:
-    """Return the platform name if jax initializes in time, else None.
-
-    Run in a subprocess: when the axon TPU tunnel hangs, even `import jax`
-    blocks at interpreter start (sitecustomize registers the PJRT plugin),
-    so an in-process probe could never time out."""
-    code = "import jax; print(jax.devices()[0].platform)"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s,
-            env=os.environ.copy(),
-        )
-        if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        return None
-    except Exception:
-        return None
-    return None
-
-
 def cpu_env() -> dict:
-    env = os.environ.copy()
-    env["PYTHONPATH"] = ""          # skip the axon sitecustomize entirely
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["BENCH_IN_CPU_FALLBACK"] = "1"
+    env = clean_cpu_env(BENCH_IN_CPU_FALLBACK="1")
     # CPU runs (probe-timeout fallback AND BENCH_FORCE_CPU) default to a
     # smaller scale: one core crunches the [G] batch serially, so the
     # device-scale default just measures the same code slower.  An
@@ -228,8 +204,10 @@ def main() -> None:
             run_cpu_subprocess(None)
             return
         timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-        if probe_backend(timeout_s) is None:
-            run_cpu_subprocess("device backend probe timed out")
+        ndev, why = probe_devices(timeout_s)
+        if ndev is None:
+            # record the REAL failure (hang vs fast crash) in the artifact
+            run_cpu_subprocess(f"device backend unavailable: {why}")
             return
     try:
         run_bench()
