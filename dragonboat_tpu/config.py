@@ -36,6 +36,10 @@ class Config:
     # TPU-native surface: run this shard as a lane of the host's batched
     # device kernel instead of a host-Python Peer (engine/kernel_engine.py)
     device_resident: bool = False
+    # run this shard's replica as a row of the process-wide multi-chip
+    # mesh engine (ExpertConfig.mesh places it; engine/mesh_engine.py) —
+    # replicas live on different devices and exchange messages over ICI
+    mesh_resident: bool = False
 
     def validate(self) -> None:
         if self.replica_id == 0:
@@ -68,9 +72,32 @@ class EngineConfig:
     close_shards: int = 32
 
 
+@dataclass(frozen=True)
+class MeshSpec:
+    """Placement of device-resident shards onto a multi-chip mesh.
+
+    NodeHosts (one per replica slot in the common deployment) that share
+    a ``name`` attach to one process-wide MeshEngine whose state spans a
+    ``Mesh(('g','r'))`` of ``g_size * replicas`` devices; intra-group
+    raft traffic rides ICI collectives instead of the host transport
+    (the reference's multi-NodeHost TCP topology, transport.go:86-101,
+    collapsed into the jitted step).  Mesh-resident shards must use
+    replica ids 1..replicas (the device router's fixed addressing);
+    anything else falls back / evicts to the host engine.
+    """
+
+    name: str = "default"
+    g_size: int = 1          # mesh axis 'g' (disjoint group sets)
+    replicas: int = 3        # mesh axis 'r' (one device per replica slot)
+    n_local: int = 8         # group lanes per 'g' block
+
+
 @dataclass
 class ExpertConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
+    # multi-chip placement for mesh_resident shards (None = single-device
+    # kernel engine only)
+    mesh: MeshSpec | None = None
     # pluggable filesystem (config.go Expert.FS / vfs.IFS): OSFS by
     # default; MemFS for diskless tests; ErrorFS for fault injection
     fs: object | None = None

@@ -81,10 +81,14 @@ class KernelNode(Node):
         # set (under self.mu) when the shard is evicted: every later
         # ingress mutation is redirected to the host-resident successor
         self._moved: Node | None = None
-        # payload mirror: log index -> full pb.Entry (device holds terms)
+        # payload mirror: log index -> full pb.Entry (device holds terms).
+        # On a mesh engine all replicas of a shard share one dict (the
+        # in-process form of payload distribution).
         self.mirror: dict[int, pb.Entry] = {}
-        # proposals staged into prop lanes this step, by slot
-        self._staged_props: list[pb.Entry] = []
+        # (entry, origin_node) staged into prop lanes this step, by slot —
+        # origin tracks whose books own the future (mesh engines forward
+        # follower-host proposals onto the leader row)
+        self._staged_props: list[tuple[pb.Entry, "KernelNode"]] = []
         self._staged_ri: pb.SystemCtx | None = None
         # remote ReadIndex ctxs forwarded from follower hosts, FIFO
         self._remote_reads: list[tuple[int, pb.SystemCtx]] = []
@@ -406,23 +410,39 @@ class KernelEngine:
             inp.reset()
             had_work = False
 
+            # staging may target OTHER rows' prop slots (mesh engines
+            # forward follower-host proposals to the leader row), so all
+            # staging books reset before any lane stages
+            self._slot_cursor: dict[int, int] = {}
+            for n in nodes.values():
+                n._staged_props = []
             for g, n in list(nodes.items()):
                 if self._stage_lane(g, n, inbox, inp):
                     had_work = True
-                if n.shard_id not in self.by_shard:  # evicted while staging
+                if not self._is_registered(n):  # evicted while staging
                     nodes.pop(g)
-            if not had_work:
+            if not (had_work or self._device_pending()):
                 return False
 
             with self._step_timer.measure():
                 with annotate("kernel_engine.step"):
-                    state, out = kernel_step(
-                        self.kp, self.state, inbox.to_device(),
-                        inp.to_device())
+                    state, out = self._kernel_call(inbox, inp)
                 with annotate("kernel_engine.process_outputs"):
                     self.state = state
                     self._process_outputs(nodes, out)
             return True
+
+    def _is_registered(self, n: KernelNode) -> bool:
+        return n.shard_id in self.by_shard
+
+    def _device_pending(self) -> bool:
+        """Mesh engines carry a device-resident inbox between steps; the
+        single-device engine rebuilds its inbox from host queues."""
+        return False
+
+    def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
+        return kernel_step(self.kp, self.state, inbox.to_device(),
+                           inp.to_device())
 
     # -- staging ----------------------------------------------------------
 
@@ -489,21 +509,8 @@ class KernelEngine:
 
         # proposals -> prop lanes (payload staged by slot, fate correlated
         # in _process_outputs)
-        n._staged_props = []
-        slot = 0
-        if cc_entry is not None:
-            inp.prop(g, slot, True)
-            n._staged_props.append(cc_entry)
-            slot += 1
-            work = True
-        for e in props:
-            if slot >= inp.B:
-                with n.mu:
-                    n.incoming_proposals.append(e)
-                continue
-            inp.prop(g, slot, False)
-            n._staged_props.append(e)
-            slot += 1
+        if cc_entry is not None or props:
+            self._stage_props(g, n, inp, cc_entry, props)
             work = True
 
         # one batched ReadIndex ctx per step: prefer a forwarded remote
@@ -527,7 +534,7 @@ class KernelEngine:
                     # forward to the leader host (raft.go ReadIndex
                     # leader forwarding)
                     n._local_ri_pending[ctx.low] = ctx
-                    self.send_message(pb.Message(
+                    n.send_message(pb.Message(
                         type=MT.READ_INDEX, from_=n.replica_id,
                         to=n._leader_cache, shard_id=n.shard_id,
                         hint=ctx.low, hint_high=ctx.high))
@@ -546,6 +553,37 @@ class KernelEngine:
             work = True
         inp.applied(g, n.sm.get_last_applied())
         return work
+
+    def _prop_target(self, n: KernelNode) -> tuple[int, KernelNode]:
+        """(row, node) whose prop lanes this node's proposals stage into.
+        The single-device engine always proposes on its own lane (the
+        kernel drops non-leader proposals and the client retries); mesh
+        engines override to forward to the group's leader row."""
+        return n.lane, n
+
+    def _stage_props(self, g: int, n: KernelNode, inp: _InputBuilder,
+                     cc_entry, props) -> None:
+        """Stage cc + proposals into prop slots, remembering the origin
+        node per slot so fates (drop/mirror) land on the right books."""
+        tg, tn = self._prop_target(n)
+        slot = self._slot_cursor.get(tg, 0)
+        if cc_entry is not None:
+            if slot < inp.B:
+                inp.prop(tg, slot, True)
+                tn._staged_props.append((cc_entry, n))
+                slot += 1
+            else:
+                with n.mu:
+                    n.config_change_entry = n.config_change_entry or cc_entry
+        for e in props:
+            if slot >= inp.B:
+                with n.mu:
+                    n.incoming_proposals.append(e)
+                continue
+            inp.prop(tg, slot, False)
+            tn._staged_props.append((e, n))
+            slot += 1
+        self._slot_cursor[tg] = slot
 
     def _peers_of(self, n: KernelNode) -> dict[int, str]:
         m = n.sm.get_membership()
@@ -580,19 +618,20 @@ class KernelEngine:
                                np.asarray(self.state.lt[idx])))
 
         for g, n in nodes.items():
-            # 1. proposal fates
-            for slot, entry in enumerate(n._staged_props):
+            # 1. proposal fates (origin holds the future's books — on a
+            # mesh engine forwarded proposals stage on the leader row)
+            for slot, (entry, origin) in enumerate(n._staged_props):
                 if o["prop_accepted"][g, slot]:
                     index = int(o["prop_index"][g, slot])
                     term = int(o["prop_term"][g, slot])
                     n.mirror[index] = _dc_replace(entry, index=index, term=term)
                 else:
                     if entry.is_config_change():
-                        n.pending_config_change.done(
+                        origin.pending_config_change.done(
                             entry.key, RequestResultCode.DROPPED)
                     else:
-                        n._rl_release(entry.key)
-                        n.pending_proposals.dropped(entry.key)
+                        origin._rl_release(entry.key)
+                        origin.pending_proposals.dropped(entry.key)
             n._staged_props = []
 
             # 2. outgoing messages
@@ -601,16 +640,21 @@ class KernelEngine:
             # 3. persistence batch
             ud = self._build_update(g, n, o, lt_rows.get(g))
             if ud is not None:
-                updates.append(ud)
+                updates.append((n, ud))
 
         # replicate-before-fsync (engine.go:1332-1343)
-        for m in replicates:
-            self._send(m)
+        for sender, m in replicates:
+            self._send(sender, m)
         if updates:
-            n0 = next(iter(nodes.values()))
-            n0.logdb.save_raft_state(updates, worker_id=0)
-        for m in others:
-            self._send(m)
+            # one batched fsync per LogDB (nodes of a shared mesh engine
+            # belong to different NodeHosts, each with its own LogDB)
+            by_db: dict[int, tuple[object, list]] = {}
+            for n, ud in updates:
+                by_db.setdefault(id(n.logdb), (n.logdb, []))[1].append(ud)
+            for db, uds in by_db.values():
+                db.save_raft_state(uds, worker_id=0)
+        for sender, m in others:
+            self._send(sender, m)
 
         for g, n in nodes.items():
             n._committed_cache = int(o["commit"][g])
@@ -633,7 +677,7 @@ class KernelEngine:
             rt = int(o["r_type"][g, k])
             if rt == 0:
                 continue
-            others.append(pb.Message(
+            others.append((n, pb.Message(
                 type=pb.MessageType(rt), to=int(o["r_to"][g, k]),
                 from_=n.replica_id, shard_id=shard,
                 term=int(o["r_term"][g, k]),
@@ -641,7 +685,7 @@ class KernelEngine:
                 reject=bool(o["r_reject"][g, k]),
                 hint=int(o["r_hint"][g, k]),
                 hint_high=int(o["r_hint_high"][g, k]),
-            ))
+            )))
         # per-peer lanes
         for p in range(pid.shape[1]):
             to = int(pid[g, p])
@@ -660,24 +704,24 @@ class KernelEngine:
                     elif e.term != term:
                         e = _dc_replace(e, term=term)
                     ents.append(e)
-                replicates.append(pb.Message(
+                replicates.append((n, pb.Message(
                     type=MT.REPLICATE, to=to, from_=n.replica_id,
                     shard_id=shard, term=int(o["term"][g]),
                     log_index=prev, log_term=int(o["s_prev_term"][g, p]),
                     commit=int(o["s_commit"][g, p]),
                     entries=tuple(ents),
-                ))
+                )))
             if o["s_hb"][g, p]:
-                others.append(pb.Message(
+                others.append((n, pb.Message(
                     type=MT.HEARTBEAT, to=to, from_=n.replica_id,
                     shard_id=shard, term=int(o["term"][g]),
                     commit=int(o["s_hb_commit"][g, p]),
                     hint=int(o["s_hb_low"][g, p]),
                     hint_high=int(o["s_hb_high"][g, p]),
-                ))
+                )))
             sv = int(o["s_vote"][g, p])
             if sv:
-                others.append(pb.Message(
+                others.append((n, pb.Message(
                     type=(MT.REQUEST_VOTE if sv == 1
                           else MT.REQUEST_PREVOTE),
                     to=to, from_=n.replica_id, shard_id=shard,
@@ -685,11 +729,11 @@ class KernelEngine:
                     log_index=int(o["s_vote_lindex"][g, p]),
                     log_term=int(o["s_vote_lterm"][g, p]),
                     hint=int(o["s_vote_hint"][g, p]),
-                ))
+                )))
             if o["s_timeout_now"][g, p]:
-                others.append(pb.Message(
+                others.append((n, pb.Message(
                     type=MT.TIMEOUT_NOW, to=to, from_=n.replica_id,
-                    shard_id=shard, term=int(o["term"][g])))
+                    shard_id=shard, term=int(o["term"][g]))))
 
     def _build_update(self, g, n, o, lt_row) -> pb.Update | None:
         first, last = int(o["save_first"][g]), int(o["save_last"][g])
@@ -729,7 +773,7 @@ class KernelEngine:
                 n.pending_reads.add_ready(ctx, index)
             elif low in n._remote_ri_inflight:
                 # remote read answered: respond to the requesting replica
-                self._send(pb.Message(
+                self._send(n, pb.Message(
                     type=MT.READ_INDEX_RESP,
                     to=n._remote_ri_inflight.pop(low),
                     from_=n.replica_id, shard_id=n.shard_id,
@@ -820,7 +864,9 @@ class KernelEngine:
             return
         n._leader_cache, n._leader_term_cache = leader, term
         n._last_leader = (leader, term)
-        self.events.leader_updated(LeaderInfo(
+        # the node's OWN hub: on a shared mesh engine each replica's
+        # listeners live on its attaching NodeHost, not the engine's
+        n.events.leader_updated(LeaderInfo(
             shard_id=n.shard_id, replica_id=n.replica_id,
             term=term, leader_id=leader))
         with n.mu:
@@ -843,10 +889,11 @@ class KernelEngine:
 
     on_evict = None  # set by NodeHost
 
-    def _send(self, m: pb.Message) -> None:
+    def _send(self, n: KernelNode, m: pb.Message) -> None:
         # local delivery between lanes of this engine happens through the
-        # owning NodeHost's dispatch (same path as remote)
-        self.send_message(m)
+        # sending node's NodeHost dispatch (same path as remote; on a
+        # shared mesh engine each node routes via its own host)
+        n.send_message(m)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,309 @@
+"""MeshEngine — raft groups whose replicas span a multi-chip device mesh.
+
+The reference scales by running one NodeHost per machine and moving every
+inter-replica message through its TCP transport (transport.go:86-101,
+engine.go:1230-1364).  Here the replicas of a mesh-resident shard are rows
+of ONE sharded kernel state over a ``Mesh(('g','r'))``: replica ``i`` of a
+group lives on a device along axis ``'r'``, and message exchange is the
+``all_gather``+route inside the jitted step (parallel/ici.py) — the
+transport seam collapses into an ICI collective while the host keeps the
+same serving duties the single-device KernelEngine has:
+
+  - client proposals / ReadIndex staged into StepInput lanes (with
+    follower-host proposals forwarded in-engine to the leader row — the
+    reference forwards MsgProp through the raft core);
+  - ONE batched ``save_raft_state`` fsync per LogDB per step;
+  - snapshots, log queries, eviction to host engines as the slow path.
+
+Deployment note: in this process every attached NodeHost drives its own
+replicas and ONE shared engine advances the mesh — the in-process form of
+a jax multi-host SPMD program where each host owns a slice of the global
+mesh.  Payload bytes live in a per-shard mirror shared by the replicas
+(the in-process form of payload distribution; the device ring carries
+terms, and ``KernelParams.inline_payloads`` carries values for the
+device-native RSM).  Partition chaos (monkey.go:170) is a device-side
+mask: a cut row neither sends nor receives on the mesh.
+
+Escalation is whole-group: all state is durable through each replica's
+LogDB, so on ``needs_host`` (or InstallSnapshot, or a membership the mesh
+cannot address) every member is rebuilt as a host-resident pycore Node on
+its own NodeHost and the group continues over the regular transport.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import MeshSpec
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.kstate import empty_inbox, init_state
+from dragonboat_tpu.engine.kernel_engine import (
+    KernelEngine,
+    KernelNode,
+    _LaneInit,
+)
+from dragonboat_tpu.logger import get_logger
+from dragonboat_tpu.parallel.ici import IciCluster, ici_serve_step
+
+_LOG = get_logger("mesh_engine")
+
+MT = pb.MessageType
+
+
+class MeshEngine(KernelEngine):
+    """A KernelEngine whose rows span a device mesh.
+
+    Row layout matches parallel/ici.py block-major addressing: row
+    ``((ig * R) + ir) * n_local + n`` is replica ``ir + 1`` of group lane
+    ``ig * n_local + n``; a flat ``P(('g','r'))`` sharding then gives
+    device ``(ig, ir)`` the rows of its replica slot."""
+
+    def __init__(self, kp: KP.KernelParams, spec: MeshSpec,
+                 events=None) -> None:
+        devs = jax.devices()
+        need = spec.g_size * spec.replicas
+        if len(devs) < need:
+            raise RuntimeError(
+                f"mesh '{spec.name}' needs {need} devices, have {len(devs)}")
+        mesh = Mesh(
+            np.array(devs[:need]).reshape(spec.g_size, spec.replicas),
+            ("g", "r"))
+        self.spec = spec
+        self.cluster = IciCluster(
+            kp=kp, mesh=mesh, replicas=spec.replicas,
+            n_local=spec.n_local, num_groups=spec.g_size * spec.n_local)
+        total = self.cluster.total_rows
+        super().__init__(kp, total, send_message=None, events=events)
+        # replica ids are fixed by the mesh addressing (route() targets
+        # rid 1..R); rows keep them even while ABSENT
+        rids = np.empty((total,), np.int32)
+        for ig in range(spec.g_size):
+            for ir in range(spec.replicas):
+                lo = (ig * spec.replicas + ir) * spec.n_local
+                rids[lo:lo + spec.n_local] = ir + 1
+        self.state = self.cluster.shard(init_state(
+            kp, total, replica_id=rids,
+            peer_ids=np.zeros((total, kp.num_peers), np.int32)))
+        # device-resident inbox carried between steps (messages ride the
+        # mesh, not the host queues)
+        self.box = self.cluster.shard(empty_inbox(kp, total))
+        self._pending_msgs = 0
+        # partition mask, host-staged each step
+        self._cut = np.zeros((total,), bool)
+        # group-lane bookkeeping
+        self._lane_of: dict[int, int] = {}            # shard_id -> lane
+        self._members: dict[int, dict[int, KernelNode]] = {}  # sid -> rid -> n
+        self._mirrors: dict[int, dict[int, pb.Entry]] = {}    # sid -> mirror
+        self._free_lanes = list(range(self.cluster.num_groups - 1, -1, -1))
+        self._free = []   # base's row free-list is unused (rows are fixed)
+        self._refs = 0    # attached NodeHosts (registry lifecycle)
+
+    # -- row addressing ----------------------------------------------------
+
+    def _row(self, lane: int, replica_id: int) -> int:
+        R, n_local = self.spec.replicas, self.spec.n_local
+        ig, n = divmod(lane, n_local)
+        return (ig * R + (replica_id - 1)) * n_local + n
+
+    # -- lane lifecycle ----------------------------------------------------
+
+    def add_shard(self, node: KernelNode, init: _LaneInit) -> None:
+        """Place one REPLICA into its mesh row.  The first member of a
+        shard allocates the group lane; later members (possibly attached
+        by other NodeHosts, possibly after a restart) join it."""
+        rids = [rid for rid, _ in init.peers]
+        if any(not (1 <= r <= self.spec.replicas) for r in rids) or not (
+                1 <= node.replica_id <= self.spec.replicas):
+            raise ValueError(
+                f"mesh-resident shard {node.shard_id}: replica ids {rids} "
+                f"outside mesh addressing 1..{self.spec.replicas}")
+        with self.mu:
+            lane = self._lane_of.get(node.shard_id)
+            if lane is None:
+                if not self._free_lanes:
+                    raise RuntimeError("mesh engine is at capacity")
+                lane = self._free_lanes.pop()
+                self._lane_of[node.shard_id] = lane
+                self._members[node.shard_id] = {}
+                self._mirrors[node.shard_id] = {}
+            members = self._members[node.shard_id]
+            if node.replica_id in members:
+                raise RuntimeError(
+                    f"replica {node.replica_id} of shard {node.shard_id} "
+                    f"already mesh-resident")
+            row = self._row(lane, node.replica_id)
+            node.lane = row
+            node.engine = self
+            node.mirror = self._mirrors[node.shard_id]   # shared payloads
+            members[node.replica_id] = node
+            self.nodes[row] = node
+            self.by_shard[(node.shard_id, node.replica_id)] = node
+            self._inject(row, node, init)
+
+    def remove_replica(self, node: KernelNode) -> KernelNode | None:
+        """Detach one replica (stop_replica / NodeHost.close); the group
+        lane lives on for the remaining members."""
+        with self.mu:
+            if self.by_shard.pop((node.shard_id, node.replica_id),
+                                 None) is None:
+                return None
+            members = self._members.get(node.shard_id, {})
+            members.pop(node.replica_id, None)
+            self.nodes.pop(node.lane, None)
+            self._clear_lane(node.lane)
+            self._cut[node.lane] = False
+            if not members:
+                lane = self._lane_of.pop(node.shard_id, None)
+                self._members.pop(node.shard_id, None)
+                self._mirrors.pop(node.shard_id, None)
+                if lane is not None:
+                    self._free_lanes.append(lane)
+        return node
+
+    def remove_shard(self, shard_id: int) -> KernelNode | None:
+        raise NotImplementedError(
+            "mesh engine removes per-replica: use remove_replica(node)")
+
+    def _is_registered(self, n: KernelNode) -> bool:
+        return (n.shard_id, n.replica_id) in self.by_shard
+
+    # -- chaos surface -----------------------------------------------------
+
+    def set_partitioned(self, node: KernelNode, cut: bool) -> None:
+        """Device-side partition mask for one replica row."""
+        with self.mu:
+            if self._is_registered(node):
+                self._cut[node.lane] = cut
+
+    # -- the step ----------------------------------------------------------
+
+    def _device_pending(self) -> bool:
+        return self._pending_msgs > 0
+
+    def _kernel_call(self, inbox, inp):
+        """Advance the mesh: host-staged inputs, device-routed messages.
+        The host inbox builder is ignored — kernel-family traffic for
+        mesh shards never crosses the host (anything staged there is a
+        stray transport delivery and is dropped by design)."""
+        cl = self.cluster
+        staged = cl.shard(inp.to_device())
+        cut = cl.shard(jax.numpy.asarray(self._cut))
+        state, box, out, pending = ici_serve_step(
+            cl, self.state, self.box, staged, cut)
+        self.box = box
+        self._pending_msgs = int(pending)
+        return state, out
+
+    def _emit_messages(self, g, n, o, pid, replicates, others) -> None:
+        # intra-group messages ride the mesh inside the step; there is
+        # nothing for the host to send (READ_INDEX forwarding and
+        # snapshot streams go through the per-node host path)
+        return
+
+    def _prop_target(self, n: KernelNode):
+        """Forward proposals to the group's leader row (any NodeHost is a
+        valid entry point, like the reference's MsgProp forwarding). Falls
+        back to the proposer's own row when no leader is known — the
+        kernel then drops and the client retries."""
+        if self._cut[n.lane]:
+            # a partitioned host's proposals must not tunnel through
+            # shared memory to the leader row — stage on the cut row,
+            # where the kernel drops them (the client sees DROPPED, as it
+            # would against the reference's silenced transport)
+            return n.lane, n
+        lid = n._leader_cache
+        if lid and lid != n.replica_id:
+            leader = self._members.get(n.shard_id, {}).get(lid)
+            if leader is not None and not self._cut[leader.lane]:
+                return leader.lane, leader
+        return n.lane, n
+
+    # -- membership / escalation ------------------------------------------
+
+    def update_lane_membership(self, node: KernelNode) -> None:
+        """Refresh the peer books of EVERY row of this group from the RSM
+        membership.  A membership the mesh cannot address (ids outside
+        1..R, or more members than peer slots) evicts the whole group."""
+        m = node.sm.get_membership()
+        kp = self.kp
+        ids = (list(m.addresses) + list(m.non_votings) + list(m.witnesses))
+        if (len(ids) > kp.num_peers
+                or any(not (1 <= r <= self.spec.replicas) for r in ids)):
+            self._evict(node, reason=f"membership {sorted(ids)} outside "
+                                     f"mesh addressing")
+            return
+        pids = np.zeros((kp.num_peers,), np.int32)
+        kinds = np.zeros((kp.num_peers,), np.int32)
+        i = 0
+        for rid in sorted(m.addresses):
+            pids[i], kinds[i] = rid, KP.K_VOTER
+            i += 1
+        for rid in sorted(m.non_votings):
+            pids[i], kinds[i] = rid, KP.K_NON_VOTING
+            i += 1
+        for rid in sorted(m.witnesses):
+            pids[i], kinds[i] = rid, KP.K_WITNESS
+            i += 1
+        s = self.state
+        jp, jk = jax.numpy.asarray(pids), jax.numpy.asarray(kinds)
+        for member in list(self._members.get(node.shard_id, {}).values()):
+            s = s._replace(
+                pid=s.pid.at[member.lane].set(jp),
+                kind=s.kind.at[member.lane].set(jk),
+            )
+        self.state = s
+
+    def _evict(self, n: KernelNode, reason: str, carry=None) -> None:
+        """Whole-group escalation: every member leaves the mesh and is
+        rebuilt host-side by ITS OWN NodeHost; the group continues over
+        the regular transport (all state is already durable)."""
+        members = list(self._members.get(n.shard_id, {}).values())
+        if not members:
+            return
+        _LOG.info("shard %d: leaving the mesh (%s)", n.shard_id, reason)
+        for member in members:
+            if self.remove_replica(member) is None:
+                continue
+            cb = getattr(member, "on_evict_cb", None)
+            if cb is not None:
+                cb(member, (carry or []) if member is n else [])
+
+
+# ---------------------------------------------------------------------------
+# process-wide registry: NodeHosts sharing a MeshSpec.name share one engine
+# (the in-process form of hosts jointly executing one SPMD program)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, MeshEngine] = {}
+_REG_MU = threading.Lock()
+
+
+def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
+                       events=None) -> MeshEngine:
+    with _REG_MU:
+        eng = _REGISTRY.get(spec.name)
+        if eng is None:
+            eng = MeshEngine(kp, spec, events=events)
+            _REGISTRY[spec.name] = eng
+        else:
+            if eng.spec != spec:
+                raise RuntimeError(
+                    f"mesh '{spec.name}' geometry mismatch: engine has "
+                    f"{eng.spec}, caller wants {spec}")
+            if eng.kp != kp:
+                raise RuntimeError(
+                    f"mesh '{spec.name}' kernel params mismatch")
+        eng._refs += 1
+        return eng
+
+
+def detach_mesh_engine(eng: MeshEngine) -> None:
+    with _REG_MU:
+        eng._refs -= 1
+        if eng._refs <= 0:
+            _REGISTRY.pop(eng.spec.name, None)

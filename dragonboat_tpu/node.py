@@ -165,7 +165,17 @@ class Node:
             if members is None or not (
                 members.addresses or members.non_votings or members.witnesses
             ):
-                members = pb.Membership(addresses=dict(initial_members))
+                # the RSM membership store is authoritative once CCs have
+                # applied (snapshotter.go owns membership in the
+                # reference): a LIVE SM — kernel/mesh eviction rebuilds a
+                # Node around the running SM — carries the current
+                # members, where a snapshot may not exist yet and
+                # initial_members is empty on a restart
+                m = self.sm.get_membership()
+                if m.addresses or m.non_votings or m.witnesses:
+                    members = m
+                else:
+                    members = pb.Membership(addresses=dict(initial_members))
             p.raft.set_initial_members(
                 dict(members.addresses),
                 dict(members.non_votings),

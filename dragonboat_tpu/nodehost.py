@@ -170,6 +170,9 @@ class NodeHost:
         # the batched device engine, created on the first device-resident
         # shard (engine/kernel_engine.py)
         self.kernel_engine = None
+        # the shared multi-chip engine, attached on the first
+        # mesh-resident shard (engine/mesh_engine.py)
+        self.mesh_engine = None
         # partitioned step workers (engine.go:1107 workerPool: shards hash
         # onto fixed workers so each node is stepped by exactly one
         # thread; fsyncs of different partitions overlap)
@@ -200,6 +203,14 @@ class NodeHost:
             self._stopped = True
             nodes = list(self.nodes.values())
             self.nodes.clear()
+        if self.mesh_engine is not None:
+            from dragonboat_tpu.engine.mesh_engine import detach_mesh_engine
+
+            for n in nodes:
+                if getattr(n, "engine", None) is self.mesh_engine:
+                    self.mesh_engine.remove_replica(n)
+            detach_mesh_engine(self.mesh_engine)
+            self.mesh_engine = None
         self._work.set()
         for ev in self._worker_events:
             ev.set()
@@ -253,9 +264,11 @@ class NodeHost:
                 if self.env is not None
                 else f"/tmp/dragonboat_tpu/{self.id}/snapshots"
             )
-            device = cfg.device_resident and not cfg.is_witness
+            mesh = (cfg.mesh_resident and not cfg.is_witness
+                    and self.config.expert.mesh is not None)
+            device = cfg.device_resident and not cfg.is_witness and not mesh
             node_cls = Node
-            if device:
+            if device or mesh:
                 from dragonboat_tpu.engine.kernel_engine import KernelNode
 
                 node_cls = KernelNode
@@ -275,7 +288,9 @@ class NodeHost:
             for rid, addr in {**m.addresses, **m.non_votings, **m.witnesses}.items():
                 self.registry.add(cfg.shard_id, rid, addr)
             self.nodes[cfg.shard_id] = node
-        if device:
+        if mesh:
+            self._inject_mesh_shard(node, members)
+        elif device:
             # outside self.mu: the engine lock orders engine.mu -> host.mu
             # on the eviction path, so injection must not hold host.mu
             self._inject_kernel_shard(node, members)
@@ -287,7 +302,10 @@ class NodeHost:
             node = self.nodes.pop(shard_id, None)
         if node is None:
             raise ShardNotFoundError(f"shard {shard_id} not found")
-        if self.kernel_engine is not None:
+        if self.mesh_engine is not None and getattr(
+                node, "engine", None) is self.mesh_engine:
+            self.mesh_engine.remove_replica(node)
+        elif self.kernel_engine is not None:
             self.kernel_engine.remove_shard(shard_id)
         node.destroy()
         self.events.node_unloaded(NodeInfo(shard_id, node.replica_id))
@@ -306,20 +324,36 @@ class NodeHost:
 
         if self.kernel_engine is None:
             ex = self.config.expert
-            kp = KP.KernelParams(
-                num_peers=ex.kernel_num_peers,
-                log_cap=ex.kernel_log_cap,
-                inbox_cap=ex.kernel_inbox_cap,
-                msg_entries=ex.kernel_msg_entries,
-                proposal_cap=ex.kernel_proposal_cap,
-                readindex_cap=ex.kernel_readindex_cap,
-                apply_batch=ex.kernel_apply_batch,
-                compaction_overhead=ex.kernel_compaction_overhead,
-            )
             self.kernel_engine = KernelEngine(
-                kp, ex.kernel_capacity, self._send_message,
-                events=self.events)
+                self._kernel_params(), ex.kernel_capacity,
+                self._send_message, events=self.events)
             self.kernel_engine.on_evict = self._on_kernel_evict
+        init = self._build_lane_init(node, members)
+        self._inject_into_engine(self.kernel_engine, node, init,
+                                 "device-resident")
+
+    def _kernel_params(self, min_inbox: int = 0):
+        from dragonboat_tpu.core import params as KP
+
+        ex = self.config.expert
+        return KP.KernelParams(
+            num_peers=ex.kernel_num_peers,
+            log_cap=ex.kernel_log_cap,
+            inbox_cap=max(ex.kernel_inbox_cap, min_inbox),
+            msg_entries=ex.kernel_msg_entries,
+            proposal_cap=ex.kernel_proposal_cap,
+            readindex_cap=ex.kernel_readindex_cap,
+            apply_batch=ex.kernel_apply_batch,
+            compaction_overhead=ex.kernel_compaction_overhead,
+        )
+
+    def _build_lane_init(self, node, members: dict[int, str]):
+        """Capture persisted state from the bootstrapped pycore Peer and
+        make it durable BEFORE a device engine takes over (the lane is
+        injected with stable == last; idempotent on restart)."""
+        from dragonboat_tpu.core import params as KP
+        from dragonboat_tpu.engine.kernel_engine import _LaneInit
+
         raft = node.peer.raft
         log = raft.log
         first, last = log.first_index(), log.last_index()
@@ -338,24 +372,25 @@ class NodeHost:
             snap_term=ss.term if ss is not None else 0,
             entries=entries, peers=peers,
         )
-        # the lane is injected with stable == last, so everything the Peer
-        # held in memory (bootstrap config changes, unsaved tail) must be
-        # durable BEFORE the kernel takes over (idempotent on restart)
         self.logdb.save_raft_state([pb.Update(
             shard_id=node.shard_id, replica_id=node.replica_id,
             state=pb.State(term=raft.term, vote=raft.vote,
                            commit=log.committed),
             entries_to_save=tuple(entries),
         )], worker_id=0)
+        return init
+
+    def _inject_into_engine(self, engine, node, init, kind: str) -> None:
         try:
-            if len(entries) > self.kernel_engine.kp.log_cap:
+            if len(init.entries) > engine.kp.log_cap:
                 raise RequestError(
                     "log tail larger than the kernel ring")
-            if len(peers) > self.kernel_engine.kp.num_peers:
+            if len(init.peers) > engine.kp.num_peers:
                 raise RequestError(
                     "membership larger than the kernel peer book")
             node.peer = None  # the lane owns the protocol state now
-            self.kernel_engine.add_shard(node, init)
+            node.on_evict_cb = self._on_kernel_evict
+            engine.add_shard(node, init)
         except Exception as e:
             # fall back to the host engine rather than leaving a dead
             # shard registered (the state above is already durable)
@@ -363,8 +398,39 @@ class NodeHost:
             import logging
 
             logging.getLogger("dragonboat_tpu.nodehost").warning(
-                "shard %d: not device-resident (%s); running host-side",
-                node.shard_id, e)
+                "shard %d: not %s (%s); running host-side",
+                node.shard_id, kind, e)
+
+    def _inject_mesh_shard(self, node, members: dict[int, str]) -> None:
+        """Place this replica onto the process-wide mesh engine (the
+        multi-chip serving path, engine/mesh_engine.py): its peers live
+        on other devices along mesh axis 'r', possibly attached by other
+        NodeHosts sharing the MeshSpec."""
+        from dragonboat_tpu.engine.mesh_engine import attach_mesh_engine
+
+        # persist the bootstrap state FIRST: every fallback below rebuilds
+        # the shard host-side from the LogDB
+        init = self._build_lane_init(node, members)
+        spec = self.config.expert.mesh
+        if self.mesh_engine is None:
+            try:
+                kp = self._kernel_params(min_inbox=5 * (spec.replicas - 1))
+                self.mesh_engine = attach_mesh_engine(kp, spec,
+                                                      events=self.events)
+            except Exception as e:
+                # not enough devices / geometry mismatch with an already-
+                # attached engine: run host-side rather than leaving a
+                # dead shard registered
+                node.peer = None
+                self._on_kernel_evict(node, [])
+                import logging
+
+                logging.getLogger("dragonboat_tpu.nodehost").warning(
+                    "shard %d: mesh unavailable (%s); running host-side",
+                    node.shard_id, e)
+                return
+        self._inject_into_engine(self.mesh_engine, node, init,
+                                 "mesh-resident")
 
     def _on_kernel_evict(self, knode, carry: list[pb.Message]) -> None:
         """needs_host slow path: rebuild the shard as a host-resident
@@ -457,15 +523,18 @@ class NodeHost:
                         return
                     except Exception:
                         _LOG.exception("shard %d step failed", n.shard_id)
-                if w == 0 and self.kernel_engine is not None:
-                    try:
-                        if self.kernel_engine.step_all():
-                            progressed = True
-                    except OSError as e:
-                        self._on_fatal(e)
-                        return
-                    except Exception:
-                        _LOG.exception("kernel engine step failed")
+                if w == 0:
+                    for eng in (self.kernel_engine, self.mesh_engine):
+                        if eng is None:
+                            continue
+                        try:
+                            if eng.step_all():
+                                progressed = True
+                        except OSError as e:
+                            self._on_fatal(e)
+                            return
+                        except Exception:
+                            _LOG.exception("device engine step failed")
 
     def run_once(self) -> int:
         """Step every node until quiescent; returns steps executed."""
@@ -485,16 +554,18 @@ class NodeHost:
                     return steps
                 except Exception:
                     _LOG.exception("shard %d step failed", n.shard_id)
-            if self.kernel_engine is not None:
+            for eng in (self.kernel_engine, self.mesh_engine):
+                if eng is None:
+                    continue
                 try:
-                    if self.kernel_engine.step_all():
+                    if eng.step_all():
                         progressed = True
                         steps += 1
                 except OSError as e:
                     self._on_fatal(e)
                     return steps
                 except Exception:
-                    _LOG.exception("kernel engine step failed")
+                    _LOG.exception("device engine step failed")
         return steps
 
     def _on_fatal(self, exc: Exception) -> None:
@@ -928,6 +999,7 @@ class NodeHost:
         t = self.transport
         if hasattr(t, "partitioned"):
             t.partitioned = True
+        self._set_mesh_partitioned(True)
 
     def restore_partitioned_node(self) -> None:
         """monkey.go:178 RestorePartitionedNode."""
@@ -935,7 +1007,19 @@ class NodeHost:
         t = self.transport
         if hasattr(t, "partitioned"):
             t.partitioned = False
+        self._set_mesh_partitioned(False)
         self._work.set()
+
+    def _set_mesh_partitioned(self, cut: bool) -> None:
+        """Mesh traffic never crosses the host transport, so a monkey
+        partition of this host also masks its mesh rows device-side."""
+        if self.mesh_engine is None:
+            return
+        with self.mu:
+            nodes = list(self.nodes.values())
+        for n in nodes:
+            if getattr(n, "engine", None) is self.mesh_engine:
+                self.mesh_engine.set_partitioned(n, cut)
 
     def get_session_hash(self, shard_id: int) -> int:
         """Convergence oracle over the session book (monkey.go:117)."""

@@ -103,18 +103,14 @@ def make_ici_cluster(
     return cluster, cluster.shard(state), cluster.shard(box)
 
 
-def _ici_body(kp: KP.KernelParams, replicas: int,
-              state: ShardState, box: Inbox, inp: StepInput):
-    """shard_map body: local [n_local] step + collective message exchange."""
-    R = replicas
-    state, out = step(kp, state, box, inp)
-
-    # exchange out-lanes across the replica axis: [n_local,...] -> [R, n_local,...]
+def _exchange(kp: KP.KernelParams, R: int, n_local: int,
+              out: StepOutput) -> Inbox:
+    """Collective message exchange: all_gather the out-lanes over the
+    replica axis, rebuild the grouped view, reuse the single-device
+    router, keep the rows addressed to my replica slot."""
     gathered = jax.tree.map(
         lambda x: jax.lax.all_gather(x, "r", axis=0), out
     )
-
-    n_local = state.term.shape[0]
 
     def to_grouped(x):  # [R, n_local, ...] -> [n_local * R, ...] group-major
         if x is None:  # optional lanes (e.g. s_ent_val without payloads)
@@ -130,7 +126,14 @@ def _ici_body(kp: KP.KernelParams, replicas: int,
         g = x.reshape((n_local, R) + x.shape[1:])
         return jax.lax.dynamic_index_in_dim(g, t, axis=1, keepdims=False)
 
-    box = jax.tree.map(mine, box_full)
+    return jax.tree.map(mine, box_full)
+
+
+def _ici_body(kp: KP.KernelParams, replicas: int,
+              state: ShardState, box: Inbox, inp: StepInput):
+    """shard_map body: local [n_local] step + collective message exchange."""
+    state, out = step(kp, state, box, inp)
+    box = _exchange(kp, replicas, state.term.shape[0], out)
     return state, box, out
 
 
@@ -153,6 +156,63 @@ def ici_cluster_step(cluster: IciCluster, state: ShardState, box: Inbox,
     Equivalent of router.cluster_step for mesh-resident replicas; the
     transport seam (raftio.ITransport) is the all_gather inside."""
     return _jit_ici_step(cluster.kp, cluster, state, box, inp)
+
+
+def _mask_outgoing(out: StepOutput, cut: jnp.ndarray) -> StepOutput:
+    """Zero the message-valid lanes of cut rows (device-side partition:
+    the chaos surface monkey.go:170 PartitionNode expressed as a mask —
+    a partitioned replica neither sends nor receives, but still ticks,
+    persists and applies locally)."""
+
+    def z(a):
+        c = cut.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(c, jnp.zeros_like(a), a)
+
+    return out._replace(
+        r_type=z(out.r_type), s_rep=z(out.s_rep), s_hb=z(out.s_hb),
+        s_vote=z(out.s_vote), s_timeout_now=z(out.s_timeout_now),
+    )
+
+
+def _serve_body(kp: KP.KernelParams, replicas: int,
+                state: ShardState, box: Inbox, inp: StepInput,
+                cut: jnp.ndarray):
+    """shard_map body for the SERVING path: host-staged StepInput, a
+    device-resident inbox carried between steps, and a partition mask.
+
+    Returns (state, next_box, out, pending): ``pending`` counts routed
+    messages still in flight so the host keeps stepping until the mesh
+    drains even when no client work arrived."""
+    state, out = step(kp, state, box, inp)
+    box = _exchange(kp, replicas, state.term.shape[0],
+                    _mask_outgoing(out, cut))
+    # a cut row receives nothing either
+    box = box._replace(mtype=jnp.where(cut[:, None], 0, box.mtype))
+    pending = jax.lax.psum(
+        (box.mtype != 0).sum().astype(jnp.int32), ("g", "r"))
+    return state, box, out, pending
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _jit_serve_step(kp, cluster: IciCluster, state, box, inp, cut):
+    body = jax.shard_map(
+        functools.partial(_serve_body, kp, cluster.replicas),
+        mesh=cluster.mesh,
+        in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")),
+                  PS(("g", "r"))),
+        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")), PS()),
+        check_vma=False,
+    )
+    return body(state, box, inp, cut)
+
+
+def ici_serve_step(cluster: IciCluster, state: ShardState, box: Inbox,
+                   inp: StepInput, cut):
+    """One serving step: kernel + in-mesh routing + partition mask.
+
+    The mesh-engine equivalent of router.cluster_step — the transport
+    seam (transport.go:86-101) is the all_gather inside the body."""
+    return _jit_serve_step(cluster.kp, cluster, state, box, inp, cut)
 
 
 def self_driving_input(kp: KP.KernelParams, state: ShardState,
