@@ -419,8 +419,15 @@ class KernelEngine:
             for g, n in list(nodes.items()):
                 if self._stage_lane(g, n, inbox, inp):
                     had_work = True
-                if not self._is_registered(n):  # evicted while staging
-                    nodes.pop(g)
+            # an eviction while staging (InstallSnapshot; whole-GROUP on a
+            # mesh engine) may remove rows staged EARLIER in this loop —
+            # drop them all, failing any proposals forwarded onto them so
+            # the origin futures fail fast instead of timing out
+            for g, n in list(nodes.items()):
+                if self._is_registered(n):
+                    continue
+                self._drop_staged_fates(n)
+                nodes.pop(g)
             if not (had_work or self._device_pending()):
                 return False
 
@@ -434,6 +441,16 @@ class KernelEngine:
 
     def _is_registered(self, n: KernelNode) -> bool:
         return n.shard_id in self.by_shard
+
+    def _drop_staged_fates(self, n: KernelNode) -> None:
+        for entry, origin in n._staged_props:
+            if entry.is_config_change():
+                origin.pending_config_change.done(
+                    entry.key, RequestResultCode.DROPPED)
+            else:
+                origin._rl_release(entry.key)
+                origin.pending_proposals.dropped(entry.key)
+        n._staged_props = []
 
     def _device_pending(self) -> bool:
         """Mesh engines carry a device-resident inbox between steps; the
@@ -657,6 +674,11 @@ class KernelEngine:
             self._send(sender, m)
 
         for g, n in nodes.items():
+            # a whole-group eviction earlier in THIS loop (mesh engine)
+            # already handed the sibling rows to host-resident successor
+            # nodes — touching their SMs/books here would race them
+            if not self._is_registered(n):
+                continue
             n._committed_cache = int(o["commit"][g])
             # 4. ReadIndex results
             self._complete_reads(g, n, o)
@@ -824,8 +846,14 @@ class KernelEngine:
             self._take_lane_snapshot(n, _SnapshotRequest())
         self._prune_mirror(n)
 
+    def _mirror_floor(self, n: KernelNode) -> int:
+        """Lowest applied cursor that still needs mirror payloads.  On a
+        shared mesh mirror this is the MINIMUM across the shard's
+        replicas (a lagging/cut member must still find its entries)."""
+        return n.sm.get_last_applied()
+
     def _prune_mirror(self, n: KernelNode) -> None:
-        floor = n.sm.get_last_applied() - self.kp.compaction_overhead
+        floor = self._mirror_floor(n) - self.kp.compaction_overhead
         if floor <= 0 or len(n.mirror) <= self.kp.log_cap:
             return
         for idx in [i for i in n.mirror if i < floor]:

@@ -93,8 +93,9 @@ class MeshEngine(KernelEngine):
         # mesh, not the host queues)
         self.box = self.cluster.shard(empty_inbox(kp, total))
         self._pending_msgs = 0
-        # partition mask, host-staged each step
+        # partition mask; device copy cached until the mask changes
         self._cut = np.zeros((total,), bool)
+        self._cut_dev = None
         # group-lane bookkeeping
         self._lane_of: dict[int, int] = {}            # shard_id -> lane
         self._members: dict[int, dict[int, KernelNode]] = {}  # sid -> rid -> n
@@ -157,6 +158,7 @@ class MeshEngine(KernelEngine):
             self.nodes.pop(node.lane, None)
             self._clear_lane(node.lane)
             self._cut[node.lane] = False
+            self._cut_dev = None
             if not members:
                 lane = self._lane_of.pop(node.shard_id, None)
                 self._members.pop(node.shard_id, None)
@@ -172,6 +174,11 @@ class MeshEngine(KernelEngine):
     def _is_registered(self, n: KernelNode) -> bool:
         return (n.shard_id, n.replica_id) in self.by_shard
 
+    def _mirror_floor(self, n: KernelNode) -> int:
+        members = self._members.get(n.shard_id, {}).values()
+        return min((m.sm.get_last_applied() for m in members),
+                   default=n.sm.get_last_applied())
+
     # -- chaos surface -----------------------------------------------------
 
     def set_partitioned(self, node: KernelNode, cut: bool) -> None:
@@ -179,6 +186,7 @@ class MeshEngine(KernelEngine):
         with self.mu:
             if self._is_registered(node):
                 self._cut[node.lane] = cut
+                self._cut_dev = None
 
     # -- the step ----------------------------------------------------------
 
@@ -192,9 +200,10 @@ class MeshEngine(KernelEngine):
         stray transport delivery and is dropped by design)."""
         cl = self.cluster
         staged = cl.shard(inp.to_device())
-        cut = cl.shard(jax.numpy.asarray(self._cut))
+        if self._cut_dev is None:
+            self._cut_dev = cl.shard(jax.numpy.asarray(self._cut))
         state, box, out, pending = ici_serve_step(
-            cl, self.state, self.box, staged, cut)
+            cl, self.state, self.box, staged, self._cut_dev)
         self.box = box
         self._pending_msgs = int(pending)
         return state, out

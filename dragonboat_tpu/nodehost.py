@@ -380,6 +380,17 @@ class NodeHost:
         )], worker_id=0)
         return init
 
+    def _fallback_host_side(self, node, kind: str, err) -> None:
+        """Run a shard host-side rather than leaving a dead device shard
+        registered (its bootstrap state is already durable)."""
+        node.peer = None
+        self._on_kernel_evict(node, [])
+        import logging
+
+        logging.getLogger("dragonboat_tpu.nodehost").warning(
+            "shard %d: not %s (%s); running host-side",
+            node.shard_id, kind, err)
+
     def _inject_into_engine(self, engine, node, init, kind: str) -> None:
         try:
             if len(init.entries) > engine.kp.log_cap:
@@ -392,14 +403,7 @@ class NodeHost:
             node.on_evict_cb = self._on_kernel_evict
             engine.add_shard(node, init)
         except Exception as e:
-            # fall back to the host engine rather than leaving a dead
-            # shard registered (the state above is already durable)
-            self._on_kernel_evict(node, [])
-            import logging
-
-            logging.getLogger("dragonboat_tpu.nodehost").warning(
-                "shard %d: not %s (%s); running host-side",
-                node.shard_id, kind, e)
+            self._fallback_host_side(node, kind, e)
 
     def _inject_mesh_shard(self, node, members: dict[int, str]) -> None:
         """Place this replica onto the process-wide mesh engine (the
@@ -418,16 +422,9 @@ class NodeHost:
                 self.mesh_engine = attach_mesh_engine(kp, spec,
                                                       events=self.events)
             except Exception as e:
-                # not enough devices / geometry mismatch with an already-
-                # attached engine: run host-side rather than leaving a
-                # dead shard registered
-                node.peer = None
-                self._on_kernel_evict(node, [])
-                import logging
-
-                logging.getLogger("dragonboat_tpu.nodehost").warning(
-                    "shard %d: mesh unavailable (%s); running host-side",
-                    node.shard_id, e)
+                # not enough devices, or geometry mismatch with an
+                # already-attached engine
+                self._fallback_host_side(node, "mesh-resident", e)
                 return
         self._inject_into_engine(self.mesh_engine, node, init,
                                  "mesh-resident")

@@ -186,8 +186,15 @@ def _serve_body(kp: KP.KernelParams, replicas: int,
     state, out = step(kp, state, box, inp)
     box = _exchange(kp, replicas, state.term.shape[0],
                     _mask_outgoing(out, cut))
-    # a cut row receives nothing either
-    box = box._replace(mtype=jnp.where(cut[:, None], 0, box.mtype))
+    # a cut row receives nothing either — zero EVERY field, not just the
+    # type: the kernel's inbox contract is route()'s (invalid slots are
+    # all-zero), and a slot with mtype=0 but a live term would still feed
+    # term adoption (caught by tests/test_mesh_differential.py)
+    box = jax.tree.map(
+        lambda x: jnp.where(
+            cut.reshape((-1,) + (1,) * (x.ndim - 1)), jnp.zeros_like(x), x),
+        box,
+    )
     pending = jax.lax.psum(
         (box.mtype != 0).sum().astype(jnp.int32), ("g", "r"))
     return state, box, out, pending
