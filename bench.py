@@ -12,12 +12,22 @@ with a timeout and the bench degrades to CPU rather than recording nothing).
 Baseline: the reference's 9M writes/s peak (3× 22-core Xeon servers,
 BASELINE.md) — vs_baseline is measured/9e6.
 
+Phases (one JSON line carries all of them): A headline write throughput
+(uninstrumented), A2 commit-latency percentiles (stamp-ring instrumented
+loop, leader-side release), B 9:1 ReadIndex:write mix (config #3), C
+10k-shard election storm with randomized drops + pre-vote (config #4).
+
 Env knobs: BENCH_GROUPS (default 8192 on device, 1024 on the CPU
 fallback — one core crunches the batch serially, so scale only slows the
-same measurement), BENCH_STEPS (default 200),
-BENCH_PROBE_TIMEOUT (default 180 s), BENCH_FORCE_CPU=1, BENCH_DEVICE_SM=1
-(run the full data path: committed writes applied to the device-resident
-KV state machine by the fused rsm-apply kernel, rsm/device_kv.py).
+same measurement), BENCH_STEPS (default 200), BENCH_CHUNK (device-launch
+chunking under the ~60 s watchdog), BENCH_PROBE_TIMEOUT (default 180 s),
+BENCH_FORCE_CPU=1, BENCH_LAT_STEPS / BENCH_MIXED_STEPS (phase lengths),
+BENCH_STORM=0 (skip phase C), BENCH_STORM_GROUPS / BENCH_STORM_STEPS /
+BENCH_STORM_DROP (storm shape), BENCH_DEVICE_SM=1 (full data path:
+committed writes applied to the device-resident KV state machine by the
+fused rsm-apply kernel, rsm/device_kv.py), BENCH_PALLAS=1 (with
+BENCH_DEVICE_SM: route the apply through the pallas block kernel,
+rsm/device_kv_pallas.py).
 """
 
 import json
@@ -87,14 +97,99 @@ def run_bench() -> None:
     fail("run", last or "no config attempted")
 
 
+def _pctile(hist, q: float):
+    """Percentile (in steps) from the latency bucket histogram."""
+    import numpy as np
+
+    h = np.asarray(hist, np.int64)
+    c = h.cumsum()
+    if c[-1] == 0:
+        return None
+    return int(np.searchsorted(c, q * c[-1], side="left"))
+
+
+def _run_storm(platform: str) -> dict:
+    """BASELINE config #4: election storm with randomized drops +
+    pre-vote across BENCH_STORM_GROUPS shards (default 10k; the CPU
+    fallback crunches the batch serially so it defaults smaller)."""
+    import time as _t
+
+    import numpy as np
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        make_cluster,
+        run_steps,
+        run_steps_storm,
+    )
+    from dragonboat_tpu.core import params as KP
+    from dragonboat_tpu.core.kstate import empty_inbox
+    import jax.numpy as jnp
+
+    replicas = 3
+    default_g = "10000" if platform != "cpu" else "4096"
+    g = int(os.environ.get("BENCH_STORM_GROUPS", default_g))
+    storm_steps = int(os.environ.get("BENCH_STORM_STEPS", "30"))
+    drop_p = float(os.environ.get("BENCH_STORM_DROP", "0.25"))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    # pre-vote everywhere: failed campaigns must not inflate terms
+    state = state._replace(pre_vote=jnp.ones_like(state.pre_vote))
+    box = empty_inbox(kp, state.term.shape[0])
+
+    # compile the recovery-loop executable BEFORE the timed window (a
+    # first-call jit would otherwise inflate recovery_ms); 10 pre-storm
+    # ticks are semantically part of the cold start
+    chunk = 10
+    state, box = run_steps(kp, replicas, chunk, True, False, state, box)
+    state.term.block_until_ready()
+
+    # cold start under drops IS the storm: g simultaneous campaigns
+    state, box = run_steps_storm(kp, replicas, storm_steps, drop_p, 42,
+                                 state, box)
+    state.term.block_until_ready()
+    role = np.asarray(state.role).reshape(-1, replicas)
+    storm_coverage = float((role == KP.LEADER).sum(axis=1).clip(0, 1).mean())
+
+    # clean network: measure steps (and wall) to one leader everywhere
+    t0 = _t.time()
+    recovered_steps = None
+    done = 0
+    while done < 400:
+        state, box = run_steps(kp, replicas, chunk, True, False, state, box)
+        done += chunk
+        role = np.asarray(state.role).reshape(-1, replicas)
+        if ((role == KP.LEADER).sum(axis=1) == 1).all():
+            recovered_steps = done
+            break
+    dt = _t.time() - t0
+    step_ms = dt / max(done, 1) * 1e3
+    return {
+        "groups": g,
+        "storm_steps": storm_steps,
+        "drop_p": drop_p,
+        "leader_coverage_after_storm": round(storm_coverage, 4),
+        "recovery_steps": recovered_steps,
+        # null when the cluster never reached one-leader-everywhere — a
+        # 400-step timeout must not read as an achieved latency
+        "recovery_ms": (round(step_ms * recovered_steps, 1)
+                        if recovered_steps is not None else None),
+        "recovery_step_ms": round(step_ms, 2),
+        **({} if recovered_steps is not None
+           else {"timed_out_after_steps": done}),
+    }
+
+
 def _measure(platform: str, groups: int, steps: int) -> None:
     import numpy as np
 
     from dragonboat_tpu.bench_loop import (  # noqa: F401
         bench_params,
         elect_all,
+        lat_init,
         make_cluster,
         run_steps,
+        run_steps_lat,
     )
     from dragonboat_tpu.core import params as KP
 
@@ -107,6 +202,8 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     else:
         kp = bench_params(replicas)
 
+    import jax.numpy as jnp
+
     t_build = time.time()
     state = make_cluster(kp, groups, replicas)
     state, box = elect_all(kp, replicas, state)
@@ -117,7 +214,11 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     if device_sm:
         from dragonboat_tpu.bench_loop import make_device_sm, run_steps_sm
 
-        kv, kv_state = make_device_sm(groups, replicas)
+        # BENCH_PALLAS=1 flips the apply to the fused pallas kernel
+        # (VMEM-resident table block; interpret-mode off-TPU)
+        kv, kv_state = make_device_sm(
+            groups, replicas,
+            use_pallas=os.environ.get("BENCH_PALLAS") == "1")
 
         def run_steps(kp_, r_, n_, tick_, prop_, st_, bx_):
             nonlocal kv_state
@@ -126,38 +227,148 @@ def _measure(platform: str, groups: int, steps: int) -> None:
             sm_rejects.append(rej)
             return st_, bx_
 
-    # warmup: compile exactly the loop variants the timed region will run
-    # (iters is a static jit arg — chunk and remainder sizes each compile).
+    B = kp.proposal_cap
+    now = 0
+    if not device_sm:
+        # latency instrumentation state — only the non-SM phases use it,
+        # and the [G, log_cap] stamp ring is real HBM at device scale
+        stamp, hist, reads = lat_init(kp, state.term.shape[0])
+
+    def lat_run(iters, width, do_reads, tick, propose):
+        nonlocal state, box, stamp, hist, reads, now
+        state, box, stamp, hist, reads = run_steps_lat(
+            kp, replicas, iters, width, do_reads, tick, propose,
+            jnp.asarray(now, jnp.int32), state, box, stamp, hist, reads)
+        now += iters
+
+    def committed():
+        return np.asarray(state.committed)[lead].astype(np.int64).sum()
+
+    def timed_window(run_fn, total, snap=None):
+        """Warm the exact chunk/remainder executables, call ``snap`` to
+        capture pre-window baselines, then run ``total`` steps in
+        watchdog-safe chunks (one long device launch can trip the ~60 s
+        TPU watchdog).  Returns (warmup_s, window_s).  ONE helper so the
+        three phases cannot drift in methodology."""
+        tw = time.time()
+        run_fn(min(chunk, total))
+        if total % chunk:
+            run_fn(total % chunk)
+        state.term.block_until_ready()
+        warm_s = time.time() - tw
+        if snap is not None:
+            snap()
+        t0 = time.time()
+        done = 0
+        while done < total:
+            n = min(chunk, total - done)
+            run_fn(n)
+            done += n
+        state.committed.block_until_ready()
+        return warm_s, time.time() - t0
+
     # Default chunk scales inversely with G to keep every device launch
-    # well under the ~60 s TPU watchdog
+    # well under the ~60 s TPU watchdog; iters is a static jit arg, so
+    # timed_window warms exactly the chunk/remainder variants it runs
     default_chunk = max(2, min(25, (25 * 1024) // max(groups, 1)))
     chunk = max(1, int(os.environ.get("BENCH_CHUNK", str(default_chunk))))
-    t_compile = time.time()
-    state, box = run_steps(kp, replicas, min(chunk, steps), True, True,
-                           state, box)
-    if steps % chunk:
-        state, box = run_steps(kp, replicas, steps % chunk, True, True,
-                               state, box)
-    state.term.block_until_ready()
-    compile_s = time.time() - t_compile
 
-    sm_rejects.clear()  # warmup-phase rejects are outside the window
-    c0 = np.asarray(state.committed)[lead].astype(np.int64).sum()
-    # chunk the device loop: one fori_loop launch of N*step_ms can trip
-    # the TPU watchdog ("TPU device error") when a run exceeds ~60 s —
-    # bounded launches keep each dispatch well under it
+    # ---- phase A: write-only throughput (the headline metric runs the
+    # UNinstrumented loop; latency capture is a separate phase below —
+    # its stamp/histogram one-hots roughly double the step cost) ----
+    def plain_run(iters):
+        nonlocal state, box
+        state, box = run_steps(kp, replicas, iters, True, True, state, box)
+
+    snaps = {}
+
+    def snap_a():
+        sm_rejects.clear()  # warmup-phase rejects are outside the window
+        snaps["c0"] = committed()
+
     t0 = time.time()
-    done = 0
-    while done < steps:
-        n = min(chunk, steps - done)
-        state, box = run_steps(kp, replicas, n, True, True, state, box)
-        done += n
-    state.committed.block_until_ready()
-    dt = time.time() - t0
-    c1 = np.asarray(state.committed)[lead].astype(np.int64).sum()
-
-    writes = int(c1 - c0)
+    compile_s, dt = timed_window(plain_run, steps, snap_a)
+    writes = int(committed() - snaps["c0"])
     wps = writes / dt
+    step_ms = dt / steps * 1e3
+
+    detail = {
+        "platform": platform,
+        "groups": groups,
+        "steps": steps,
+        "wall_s": round(dt, 3),
+        "step_ms": round(step_ms, 3),
+        "writes": writes,
+        "writes_per_group_step": round(writes / steps / groups, 2),
+        "warmup_steps_s": round(compile_s, 1),
+        "total_setup_s": round(t0 - t_build + compile_s, 1),
+    }
+    if device_sm:
+        detail["sm_rejected_writes"] = int(sum(int(r) for r in sm_rejects))
+        detail["sm_apply"] = ("pallas" if kv.use_pallas else
+                              ("range" if not kv.hash_keys else "scan"))
+    else:
+        # ---- phase A2: commit-latency percentiles (instrumented loop) ----
+        lat_steps = int(os.environ.get("BENCH_LAT_STEPS",
+                                       str(max(40, steps // 2))))
+
+        def snap_lat():
+            snaps["hist0"] = np.asarray(hist).astype(np.int64)
+
+        _, dtL = timed_window(
+            lambda n: lat_run(n, B, False, True, True), lat_steps, snap_lat)
+        lat_step_ms = dtL / lat_steps * 1e3
+        histA = np.asarray(hist).astype(np.int64) - snaps["hist0"]
+        lat_ms = {}
+        for name, q in (("p50", 0.50), ("p99", 0.99), ("p99.9", 0.999)):
+            p = _pctile(histA, q)
+            # latency in instrumented steps, scaled to the HEADLINE
+            # step_ms: the pipeline depth (steps) is what the kernel
+            # determines; the production step cost is the uninstrumented
+            # one
+            lat_ms[name] = (round(p * step_ms, 3) if p is not None
+                            else None)
+        # resolution is one device step: a release in the proposing step
+        # reports 0 buckets -> "< step_ms"
+        lat_ms["resolution_ms"] = round(step_ms, 3)
+        lat_ms["instrumented_step_ms"] = round(lat_step_ms, 3)
+        detail["commit_latency_ms"] = lat_ms
+
+        # ---- phase B: 9:1 read:write mix over ReadIndex (config #3) ----
+        mixed_steps = int(os.environ.get(
+            "BENCH_MIXED_STEPS", str(max(40, steps // 2))))
+        WW = max(1, B // 8)          # narrow writes; reads dominate
+
+        def snap_mixed():
+            snaps["reads0"], snaps["cB0"] = int(np.asarray(reads)), committed()
+
+        _, dtB = timed_window(
+            lambda n: lat_run(n, WW, True, True, True), mixed_steps,
+            snap_mixed)
+        writes_b = int(committed() - snaps["cB0"])
+        ctx = int(np.asarray(reads)) - snaps["reads0"]
+        # one ReadIndex ctx serves the read batch queued behind it
+        # (raft.go ReadIndex batching); 9:1 mix => 9 reads per write
+        read_batch = 9 * WW
+        reads_ops = min(ctx * read_batch, 9 * writes_b)
+        mixed_ops = (writes_b + reads_ops) / dtB
+        detail["mixed_9to1"] = {
+            "ops_per_s": round(mixed_ops),
+            "writes_per_s": round(writes_b / dtB),
+            "read_ctx_per_s": round(ctx / dtB),
+            "read_batch_per_ctx": read_batch,
+            "steps": mixed_steps,
+            "step_ms": round(dtB / mixed_steps * 1e3, 3),
+            "vs_baseline_mixed": round(mixed_ops / 11e6, 4),
+        }
+
+        # ---- phase C: 10k-shard election storm (config #4) ----
+        if os.environ.get("BENCH_STORM", "1") == "1":
+            try:
+                detail["election_storm"] = _run_storm(platform)
+            except Exception as e:  # storm failure must not cost the run
+                detail["election_storm"] = {"error": repr(e)[-300:]}
+
     sm_note = ", device-SM apply" if device_sm else ""
     emit({
         "metric": (f"replicated writes/sec, {groups} groups x 3 replicas, "
@@ -165,19 +376,7 @@ def _measure(platform: str, groups: int, steps: int) -> None:
         "value": round(wps),
         "unit": "writes/s",
         "vs_baseline": round(wps / BASELINE_WPS, 4),
-        "detail": {
-            "platform": platform,
-            "groups": groups,
-            "steps": steps,
-            "wall_s": round(dt, 3),
-            "step_ms": round(dt / steps * 1e3, 3),
-            "writes": writes,
-            "writes_per_group_step": round(writes / steps / groups, 2),
-            "warmup_steps_s": round(compile_s, 1),
-            "total_setup_s": round(t0 - t_build, 1),
-            **({"sm_rejected_writes": int(sum(int(r) for r in sm_rejects))}
-               if device_sm else {}),
-        },
+        "detail": detail,
     })
 
 

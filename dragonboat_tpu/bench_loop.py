@@ -68,6 +68,38 @@ def make_cluster(kp: KP.KernelParams, num_groups: int, replicas: int = 3,
     return init_state(kp, G, rids, pids, election_timeout=election)
 
 
+def _self_input(kp: KP.KernelParams, state: ShardState, tick, propose,
+                write_width: int | None, do_reads: bool, now) -> StepInput:
+    """The self-driving feedback input: auto-propose on leaders (first
+    ``write_width`` lanes, or all), optional one batched ReadIndex per
+    leader, instant-apply RSM cursor, logical clock tick.  ONE builder so
+    the instrumented and headline loops cannot drift apart."""
+    G, B = state.term.shape[0], kp.proposal_cap
+    is_leader = state.role == KP.LEADER
+    pv = jnp.broadcast_to(is_leader[:, None], (G, B)) & jnp.asarray(
+        propose, bool)
+    if write_width is not None and write_width < B:
+        pv = pv & (jnp.arange(B, dtype=jnp.int32) < write_width)[None, :]
+    # inline payloads: lane j proposes value (last + 1 + j) — the entry's
+    # own index, so any replica can verify lv[slot(i)] == i for committed i
+    pval = (state.last[:, None] + 1 + jnp.arange(B, dtype=jnp.int32)[None, :])
+    ri = (is_leader & jnp.asarray(do_reads, bool)
+          & jnp.asarray(propose, bool))
+    ctx = jnp.broadcast_to(jnp.asarray(now, jnp.int32) & 0x7FFFFFFF, (G,))
+    return StepInput(
+        prop_valid=pv,
+        prop_cc=jnp.zeros((G, B), bool),
+        ri_valid=ri,
+        ri_low=ctx,
+        ri_high=ctx,
+        transfer_to=jnp.zeros((G,), jnp.int32),
+        tick=jnp.broadcast_to(jnp.asarray(tick, bool), (G,)),
+        quiesced=jnp.zeros((G,), bool),
+        applied=state.processed,  # instant-apply RSM feedback
+        prop_val=pval,
+    )
+
+
 def full_step(kp: KP.KernelParams, replicas: int, state: ShardState,
               box: Inbox, tick, propose):
     """One self-driving step: auto-propose on leaders, sync applied, tick.
@@ -75,25 +107,7 @@ def full_step(kp: KP.KernelParams, replicas: int, state: ShardState,
     ``tick``/``propose`` are traced booleans so one compiled executable
     covers the elect, settle and load phases (compiles are minutes-scale
     on TPU; variants would triple that)."""
-    G = state.term.shape[0]
-    B = kp.proposal_cap
-    is_leader = state.role == KP.LEADER
-    pv = jnp.broadcast_to(is_leader[:, None], (G, B)) & propose
-    # inline payloads: lane j proposes value (last + 1 + j) — the entry's
-    # own index, so any replica can verify lv[slot(i)] == i for committed i
-    pval = (state.last[:, None] + 1 + jnp.arange(B, dtype=jnp.int32)[None, :])
-    inp = StepInput(
-        prop_valid=pv,
-        prop_cc=jnp.zeros((G, B), bool),
-        ri_valid=jnp.zeros((G,), bool),
-        ri_low=jnp.zeros((G,), jnp.int32),
-        ri_high=jnp.zeros((G,), jnp.int32),
-        transfer_to=jnp.zeros((G,), jnp.int32),
-        tick=jnp.broadcast_to(jnp.asarray(tick, bool), (G,)),
-        quiesced=jnp.zeros((G,), bool),
-        applied=state.processed,  # instant-apply RSM feedback
-        prop_val=pval,
-    )
+    inp = _self_input(kp, state, tick, propose, None, False, 0)
     state, out = step(kp, state, box, inp)
     nxt = route(kp, replicas, out)
     return state, nxt, out
@@ -129,14 +143,15 @@ def sm_params(replicas: int = 3) -> KP.KernelParams:
 
 
 def make_device_sm(num_groups: int, replicas: int = 3,
-                   table_cap: int = 1024):
+                   table_cap: int = 1024, use_pallas: bool = False):
     """(DeviceKV, kv_state) sized for the bench cluster.  Direct-mapped:
     the range apply writes key = index mod table_cap, so every slot is
     that key's private home and no write can ever be rejected."""
     from dragonboat_tpu.rsm.device_kv import DeviceKV
 
     G = num_groups * replicas
-    kv = DeviceKV(table_cap=table_cap, hash_keys=False)
+    kv = DeviceKV(table_cap=table_cap, hash_keys=False,
+                  use_pallas=use_pallas)
     return kv, kv.init_state(G)
 
 
@@ -157,7 +172,18 @@ def full_step_sm(kp: KP.KernelParams, replicas: int, kv, state: ShardState,
     idx = out.apply_first[:, None] + jnp.arange(AB, dtype=jnp.int32)[None, :]
     valid = idx <= out.apply_last[:, None]                   # [G, AB]
     vals = jnp.take_along_axis(state.lv, idx & (CAP - 1), axis=1)
-    if not kv.hash_keys:
+    if kv.use_pallas:
+        # fused pallas apply: the table block stays in VMEM across the
+        # window (bit-identical to both XLA forms —
+        # tests/test_device_kv_pallas.py)
+        from dragonboat_tpu.rsm.device_kv_pallas import apply_kernel_pallas
+
+        key_space = (kv.table_cap // 2 if kv.hash_keys else kv.table_cap)
+        keys = idx & (key_space - 1)
+        cmds = jnp.stack([keys, vals], axis=-1)              # [G, AB, 2]
+        kv_state, (_results, ok) = apply_kernel_pallas(
+            kv, kv_state, cmds, valid)
+    elif not kv.hash_keys:
         # raft applies a CONTIGUOUS window: one-pass range apply, no
         # serial B-iteration scan (keys = index mod table_cap)
         first_key = out.apply_first & (kv.table_cap - 1)
@@ -192,6 +218,126 @@ def run_steps_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
     return jax.lax.fori_loop(
         0, iters, body,
         (state, box, kv_state, jnp.asarray(0, jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# commit-latency capture + 9:1 ReadIndex mix (BASELINE configs #2/#3 detail:
+# the reference's latency tables README.md:53-64 and the 11M ops/s mixed
+# number README.md:47)
+# ---------------------------------------------------------------------------
+
+LAT_BUCKETS = 64  # steps-to-release, 1-step buckets, last bucket saturates
+
+
+def lat_init(kp: KP.KernelParams, G: int):
+    """(stamp ring, histogram, completed-read-ctx counter)."""
+    return (jnp.zeros((G, kp.log_cap), jnp.int32),
+            jnp.zeros((LAT_BUCKETS,), jnp.int32),
+            jnp.asarray(0, jnp.int32))
+
+
+def _stamp_accepts(kp: KP.KernelParams, stamp, out, now):
+    """Record the step at which each accepted proposal entered the log.
+    One-hot select over the ring — NO dynamic scatters (the v5e
+    miscompile class PERF.md documents)."""
+    CAP = kp.log_cap
+    idx = out.prop_index & (CAP - 1)                      # [G, B]
+    iota = jnp.arange(CAP, dtype=jnp.int32)
+    hit = ((iota[None, None, :] == idx[:, :, None])
+           & out.prop_accepted[:, :, None]).any(axis=1)   # [G, CAP]
+    return jnp.where(hit, now, stamp)
+
+
+def _bucket_releases(kp: KP.KernelParams, stamp, hist, out, now, is_leader):
+    """Histogram (now - stamp) for every entry released to the RSM on
+    LEADER rows this step — the client-visible commit+apply latency in
+    steps (only leader rows carry proposal stamps; follower releases of
+    the same entries would read unstamped slots)."""
+    CAP, AB = kp.log_cap, kp.apply_batch
+    idx = out.apply_first[:, None] + jnp.arange(AB, dtype=jnp.int32)[None, :]
+    valid = ((idx <= out.apply_last[:, None])
+             & is_leader[:, None])                        # [G, AB]
+    st = jnp.take_along_axis(stamp, idx & (CAP - 1), axis=1)
+    lat = jnp.clip(now - st, 0, LAT_BUCKETS - 1)
+    oh = ((lat[:, :, None] == jnp.arange(LAT_BUCKETS, dtype=jnp.int32))
+          & valid[:, :, None])
+    return hist + oh.sum(axis=(0, 1), dtype=jnp.int32)
+
+
+def full_step_lat(kp: KP.KernelParams, replicas: int, write_width: int,
+                  do_reads: bool, state: ShardState, box: Inbox,
+                  tick, propose, now, stamp, hist, reads):
+    """``full_step`` plus latency stamping and (optionally) a batched
+    ReadIndex per leader per step — the quorum round that serves a batch
+    of linearizable reads (raft.go ReadIndex; one ctx covers every read
+    queued behind it, which is how the reference reaches its 9:1 mixed
+    number)."""
+    is_leader = state.role == KP.LEADER
+    inp = _self_input(kp, state, tick, propose, write_width, do_reads, now)
+    state, out = step(kp, state, box, inp)
+    nxt = route(kp, replicas, out)
+    stamp = _stamp_accepts(kp, stamp, out, now)
+    hist = _bucket_releases(kp, stamp, hist, out, now, is_leader)
+    reads = reads + out.rtr_valid.sum(dtype=jnp.int32)
+    return state, nxt, stamp, hist, reads
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def run_steps_lat(kp: KP.KernelParams, replicas: int, iters: int,
+                  write_width: int, do_reads: bool, tick, propose,
+                  now0, state, box, stamp, hist, reads):
+    """iters instrumented steps under one jit; carries the latency ring,
+    histogram and read counter."""
+    tick = jnp.asarray(tick, bool)
+    propose = jnp.asarray(propose, bool)
+
+    def body(i, carry):
+        st, bx, sp, hi, rd = carry
+        st, bx, sp, hi, rd = full_step_lat(
+            kp, replicas, write_width, do_reads, st, bx,
+            tick, propose, now0 + i, sp, hi, rd)
+        return st, bx, sp, hi, rd
+
+    return jax.lax.fori_loop(0, iters, body,
+                             (state, box, stamp, hist, reads))
+
+
+# ---------------------------------------------------------------------------
+# election storm (BASELINE config #4): randomized message drops + pre-vote
+# across many shards, then measure recovery to single-leader everywhere
+# ---------------------------------------------------------------------------
+
+
+def _drop_box(box: Inbox, key, p):
+    """Randomly drop routed messages: dropped slots are ALL-ZERO (the
+    kernel's inbox contract — see tests/test_mesh_differential.py)."""
+    keep = ~jax.random.bernoulli(key, p, box.mtype.shape)   # [G, K]
+
+    def z(x):
+        if x is None:
+            return None
+        k = keep if x.ndim == keep.ndim else keep[..., None]
+        return jnp.where(k, x, jnp.zeros_like(x))
+
+    return type(box)(*[z(f) for f in box])
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def run_steps_storm(kp: KP.KernelParams, replicas: int, iters: int,
+                    drop_p, seed, state: ShardState, box: Inbox):
+    """iters ticking steps with Bernoulli(drop_p) message loss — the
+    randomized-drop election storm (pre-vote keeps terms from exploding,
+    raft.go:2059 pre-vote rationale)."""
+    key0 = jax.random.PRNGKey(seed)
+    drop_p = jnp.asarray(drop_p, jnp.float32)
+
+    def body(i, carry):
+        st, bx = carry
+        st, bx, _ = full_step(kp, replicas, st, bx, True, False)
+        bx = _drop_box(bx, jax.random.fold_in(key0, i), drop_p)
+        return st, bx
+
+    return jax.lax.fori_loop(0, iters, body, (state, box))
 
 
 def elect_all(kp: KP.KernelParams, replicas: int, state: ShardState,

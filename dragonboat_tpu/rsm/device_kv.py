@@ -52,6 +52,12 @@ class DeviceKV(IDeviceStateMachine):
     # contract; hashed mode serves arbitrary key spaces (with -1 rejects
     # when a probe window fills, as any fixed-capacity table must)
     hash_keys: bool = True
+    # route applies through the pallas block kernel
+    # (rsm/device_kv_pallas.py): the table block stays VMEM-resident
+    # across the whole apply window instead of streaming [G, T] through
+    # HBM per command lane.  Bit-identical results either way
+    # (tests/test_device_kv_pallas.py); interpret-mode off-TPU
+    use_pallas: bool = False
 
     def __post_init__(self) -> None:
         assert self.table_cap & (self.table_cap - 1) == 0, \

@@ -66,3 +66,43 @@ def test_pallas_full_window_rejects_match():
     _assert_same(st_x, rx, okx, st_p, rp, okp)
     assert not np.asarray(okx)[np.asarray(valid)].all(), \
         "case should exercise rejects"
+
+
+def test_full_step_sm_pallas_path_bitwise():
+    """The BENCH_PALLAS pipeline (full raft step + fused pallas apply)
+    is bit-identical to the XLA range-apply pipeline over many steps —
+    the flag flips the implementation, never the data."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from dragonboat_tpu.bench_loop import (
+        elect_all,
+        make_cluster,
+        make_device_sm,
+        run_steps_sm,
+        sm_params,
+    )
+
+    replicas, groups = 3, 8
+    kp = sm_params(replicas)
+    state0 = make_cluster(kp, groups, replicas)
+    state0, box0 = elect_all(kp, replicas, state0)
+
+    kv_x, st_x = make_device_sm(groups, replicas, table_cap=256)
+    kv_p = dataclasses.replace(kv_x, use_pallas=True)
+    st_p = {k: jnp.copy(v) for k, v in st_x.items()}
+
+    sx, bx, st_x, rej_x = run_steps_sm(
+        kp, replicas, kv_x, 25, True, True, state0, box0, st_x)
+    sp, bp, st_p, rej_p = run_steps_sm(
+        kp, replicas, kv_p, 25, True, True, state0, box0, st_p)
+
+    for f, a, b in zip(type(sx)._fields, sx, sp):
+        if a is None:
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f
+    for k in st_x:
+        assert np.array_equal(np.asarray(st_x[k]), np.asarray(st_p[k])), k
+    assert int(rej_x) == int(rej_p) == 0
+    assert int(np.asarray(st_x["count"]).sum()) > 0
