@@ -965,6 +965,10 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     prev = s.next - 1
     prev_term, prev_comp, _ = jax.vmap(lambda i: log_term_at(kp, s, i))(prev)
     needs_snap = can_send & prev_comp  # log compacted under the peer
+    # witness peers take a file-less stripped snapshot the host can
+    # build from the recorded snapshot directly (raft.go:720-735) — no
+    # stream, no eviction; only non-witness peers escalate
+    wit_snap = needs_snap & (s.kind == P.K_WITNESS)
     send_rep = can_send & ~prev_comp
     n_avail = jnp.clip(s.last - prev, 0, E)
     lane = jnp.arange(E, dtype=I32)
@@ -983,7 +987,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
                    sel(needs_snap, P.R_SNAPSHOT, s.pstate)),
         psnap=sel(needs_snap, s.snap_index, s.psnap),
     )
-    s = mrep(s, jnp.any(needs_snap), needs_host=True)
+    s = mrep(s, jnp.any(needs_snap & ~wit_snap), needs_host=True)
 
     # heartbeat lanes (broadcastHeartbeatMessageWithHint; raft.go:859-871)
     has_ctx = (eff.hb_low != 0) | (eff.hb_high != 0)
@@ -1051,7 +1055,8 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
         s_hb_low=jnp.broadcast_to(eff.hb_low, (Pn,)),
         s_hb_high=jnp.broadcast_to(eff.hb_high, (Pn,)),
         s_timeout_now=eff.send_tn & is_leader,
-        s_need_snapshot=needs_snap,
+        s_need_snapshot=needs_snap & ~wit_snap,
+        s_wit_snap=wit_snap,
         save_first=save_first, save_last=save_last,
         apply_first=apply_first, apply_last=apply_last,
         term=s.term, vote=s.vote, commit=s.committed,

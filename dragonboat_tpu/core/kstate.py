@@ -291,6 +291,9 @@ class StepOutput(NamedTuple):
     s_hb_high: jnp.ndarray
     s_timeout_now: jnp.ndarray  # bool
     s_need_snapshot: jnp.ndarray  # bool — host must stream a snapshot
+    # bool — witness peer fell behind compaction: the host answers with a
+    # stripped file-less witness snapshot (raft.go:728) WITHOUT evicting
+    s_wit_snap: jnp.ndarray
 
     # persistence + apply pipeline [G]
     save_first: jnp.ndarray

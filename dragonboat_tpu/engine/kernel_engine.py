@@ -233,6 +233,10 @@ class KernelEngine:
         # all lanes start ABSENT: no peers -> non-single, no campaigns
         # (mask: a lane with kind all K_ABSENT and tick never set is inert)
         self._last_state_triple: dict[int, tuple[int, int, int]] = {}
+        # host mirror of the device peer-kind book: kinds only change on
+        # injection/membership updates, so the output path must not pay a
+        # device->host transfer for them every step
+        self._kind_np = np.zeros((capacity, kp.num_peers), np.int32)
         # persistent staging buffers, zeroed per step (the jitted step
         # needs fixed [capacity] shapes anyway; reallocating every engine
         # iteration would cost ~G*K*E ints of fresh numpy per step)
@@ -280,6 +284,7 @@ class KernelEngine:
         kinds = np.zeros((kp.num_peers,), np.int32)
         for i, (rid, kind) in enumerate(init.peers[:kp.num_peers]):
             pids[i], kinds[i] = rid, kind
+        self._kind_np[lane] = kinds
         lt = np.zeros((kp.log_cap,), np.int32)
         lcc = np.zeros((kp.log_cap,), bool)
         for e in init.entries:
@@ -356,6 +361,7 @@ class KernelEngine:
             pid=s.pid.at[lane].set(0),
             needs_host=s.needs_host.at[lane].set(False),
         )
+        self._kind_np[lane] = KP.K_ABSENT
         self._last_state_triple.pop(lane, None)
 
     def update_lane_membership(self, node: KernelNode) -> None:
@@ -392,6 +398,7 @@ class KernelEngine:
             pid=s.pid.at[g].set(jnp.asarray(pids)),
             kind=s.kind.at[g].set(jnp.asarray(kinds)),
         )
+        self._kind_np[g] = kinds
 
     # -- the step ---------------------------------------------------------
 
@@ -615,13 +622,18 @@ class KernelEngine:
             "r_hint_high", "s_rep", "s_prev_index", "s_prev_term", "s_commit",
             "s_n_ent", "s_ent_term", "s_vote", "s_vote_term", "s_vote_lindex",
             "s_vote_lterm", "s_vote_hint", "s_hb", "s_hb_commit", "s_hb_low",
-            "s_hb_high", "s_timeout_now", "s_need_snapshot", "save_first",
+            "s_hb_high", "s_timeout_now", "s_need_snapshot", "s_wit_snap",
+            "save_first",
             "save_last", "apply_first", "apply_last", "term", "vote",
             "commit", "rtr_valid", "rtr_index", "rtr_low", "rtr_high",
             "ri_dropped", "prop_accepted", "prop_index", "prop_term",
             "leader", "leader_term", "needs_host",
         )}
         pid = np.asarray(self.state.pid)
+        kind = self._kind_np
+        # shards whose witness peer needs a snapshot but have no recorded
+        # snapshot to strip — they take the regular eviction slow path
+        self._wit_snap_fallback: set[int] = set()
 
         updates: list[pb.Update] = []
         replicates: list[pb.Message] = []
@@ -652,7 +664,7 @@ class KernelEngine:
             n._staged_props = []
 
             # 2. outgoing messages
-            self._emit_messages(g, n, o, pid, replicates, others)
+            self._emit_messages(g, n, o, pid, kind, replicates, others)
 
             # 3. persistence batch
             ud = self._build_update(g, n, o, lt_rows.get(g))
@@ -690,8 +702,10 @@ class KernelEngine:
             # 7. escalation
             if o["needs_host"][g]:
                 self._evict(n, reason="kernel escalation")
+            elif n.shard_id in self._wit_snap_fallback:
+                self._evict(n, reason="witness snapshot without record")
 
-    def _emit_messages(self, g, n, o, pid, replicates, others) -> None:
+    def _emit_messages(self, g, n, o, pid, kind, replicates, others) -> None:
         E = self.kp.msg_entries
         shard = n.shard_id
         # response lanes
@@ -713,6 +727,7 @@ class KernelEngine:
             to = int(pid[g, p])
             if to == 0 or to == n.replica_id:
                 continue
+            to_witness = int(kind[g, p]) == KP.K_WITNESS
             if o["s_rep"][g, p]:
                 prev = int(o["s_prev_index"][g, p])
                 cnt = int(o["s_n_ent"][g, p])
@@ -725,6 +740,11 @@ class KernelEngine:
                         e = pb.Entry(index=idx, term=term)
                     elif e.term != term:
                         e = _dc_replace(e, term=term)
+                    if to_witness and not e.is_config_change():
+                        # witnesses never see payloads (raft.go:770
+                        # makeMetadataEntries); CCs ship in full
+                        e = pb.Entry(index=idx, term=term,
+                                     type=pb.EntryType.METADATA)
                     ents.append(e)
                 replicates.append((n, pb.Message(
                     type=MT.REPLICATE, to=to, from_=n.replica_id,
@@ -733,6 +753,24 @@ class KernelEngine:
                     commit=int(o["s_commit"][g, p]),
                     entries=tuple(ents),
                 )))
+            if o["s_wit_snap"][g, p]:
+                # witness peer fell behind compaction: answer with the
+                # stripped file-less snapshot built from the recorded
+                # snapshot (raft.go:713-735) — no stream, no eviction
+                ss = n.logdb.get_snapshot(n.shard_id, n.replica_id)
+                if ss is not None and not ss.is_empty():
+                    others.append((n, pb.Message(
+                        type=MT.INSTALL_SNAPSHOT, to=to,
+                        from_=n.replica_id, shard_id=shard,
+                        term=int(o["term"][g]),
+                        snapshot=_dc_replace(
+                            ss, filepath="", file_size=0, files=(),
+                            witness=True, dummy=False),
+                    )))
+                else:
+                    # nothing recorded to serve from — the regular
+                    # escalation path handles it
+                    self._wit_snap_fallback.add(n.shard_id)
             if o["s_hb"][g, p]:
                 others.append((n, pb.Message(
                     type=MT.HEARTBEAT, to=to, from_=n.replica_id,

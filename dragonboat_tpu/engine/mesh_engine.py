@@ -208,11 +208,15 @@ class MeshEngine(KernelEngine):
         self._pending_msgs = int(pending)
         return state, out
 
-    def _emit_messages(self, g, n, o, pid, replicates, others) -> None:
+    def _emit_messages(self, g, n, o, pid, kind, replicates, others) -> None:
         # intra-group messages ride the mesh inside the step; there is
         # nothing for the host to send (READ_INDEX forwarding and
-        # snapshot streams go through the per-node host path)
-        return
+        # snapshot streams go through the per-node host path).  A witness
+        # peer needing a snapshot CANNOT be served over the mesh (witness
+        # replicas are host-resident, their mesh row is absent) — the
+        # group escalates to the host engines, which recover it
+        if o["s_wit_snap"][g].any():
+            self._wit_snap_fallback.add(n.shard_id)
 
     def _prop_target(self, n: KernelNode):
         """Forward proposals to the group's leader row (any NodeHost is a
@@ -265,6 +269,7 @@ class MeshEngine(KernelEngine):
                 pid=s.pid.at[member.lane].set(jp),
                 kind=s.kind.at[member.lane].set(jk),
             )
+            self._kind_np[member.lane] = kinds
         self.state = s
 
     def _evict(self, n: KernelNode, reason: str, carry=None) -> None:

@@ -50,13 +50,86 @@ class CorruptLogError(Exception):
     """A non-tail record failed its checksum — the log is damaged."""
 
 
+class _RangeIndex:
+    """Range-based entry index (reference ``index.go:37-56`` indexEntry):
+    one appended record covering entries ``[first..last]`` costs ONE tuple
+    ``(first, last, fileno, offset)`` — not one dict slot per entry.  The
+    entries inside a record are contiguous, so the ordinal of index ``i``
+    is just ``i - first``.  Compaction keeps record-aligned ranges and a
+    visibility ``floor``: indexes at or below the floor read as absent,
+    and fully-covered ranges are dropped; a range straddling the floor
+    keeps its original ``first`` so the ordinal math stays valid.
+    """
+
+    __slots__ = ("_r", "floor")
+
+    def __init__(self) -> None:
+        # sorted by first, non-overlapping: [first, last, fileno, offset]
+        self._r: list[list[int]] = []
+        self.floor = 0
+
+    def __bool__(self) -> bool:
+        return any(r[1] > self.floor for r in self._r)
+
+    def add(self, first: int, last: int, fileno: int, off: int) -> None:
+        """Index one record; conflict-overwrite truncates any stale
+        suffix at or above ``first`` (raft log overwrite semantics)."""
+        r = self._r
+        while r and r[-1][0] >= first:
+            r.pop()
+        if r and r[-1][1] >= first:
+            r[-1][1] = first - 1
+        r.append([first, last, fileno, off])
+
+    def get(self, i: int) -> tuple[int, int, int] | None:
+        """index -> (fileno, record offset, ordinal within record)."""
+        if i <= self.floor:
+            return None
+        r = self._r
+        lo, hi = 0, len(r)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if r[mid][0] <= i:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo == 0:
+            return None
+        first, last, fileno, off = r[lo - 1]
+        if i > last:
+            return None
+        return fileno, off, i - first
+
+    def compact(self, floor: int) -> None:
+        if floor <= self.floor:
+            return
+        self.floor = floor
+        self._r = [r for r in self._r if r[1] > floor]
+
+    def contiguous_count(self, start: int) -> int:
+        """Number of consecutively-present entries from ``start``."""
+        if start <= self.floor:
+            return 0
+        count, expect = 0, start
+        for first, last, _, _ in self._r:
+            if last < expect:
+                continue
+            if first > expect:
+                break
+            count += last - expect + 1
+            expect = last + 1
+        return count
+
+    def filenos(self) -> set[int]:
+        return {r[2] for r in self._r if r[1] > self.floor}
+
+
 @dataclass
 class _Node:
     state: pb.State = field(default_factory=pb.State)
     snapshot: pb.Snapshot = field(default_factory=pb.Snapshot)
     bootstrap: pb.Bootstrap | None = None
-    # entry index -> (fileno, record offset, ordinal within record)
-    entries: dict[int, tuple[int, int, int]] = field(default_factory=dict)
+    entries: _RangeIndex = field(default_factory=_RangeIndex)
     max_index: int = 0
     removed: bool = False
 
@@ -212,12 +285,8 @@ class TanLogDB(ILogDB):
                 n.snapshot = ud.snapshot
             if ud.entries_to_save:
                 first = ud.entries_to_save[0].index
-                # conflict overwrite: drop any stale suffix above the new tail
                 tail = ud.entries_to_save[-1].index
-                for i in [i for i in n.entries if i >= first]:
-                    del n.entries[i]
-                for ordinal, e in enumerate(ud.entries_to_save):
-                    n.entries[e.index] = (fileno, off, ordinal)
+                n.entries.add(first, tail, fileno, off)
                 n.max_index = tail
             self._file_meta.setdefault(fileno, set()).add(key)
             if ud.entries_to_save:
@@ -234,8 +303,7 @@ class TanLogDB(ILogDB):
             self._file_meta.setdefault(fileno, set()).add(key)
         elif rectype == R_COMPACT:
             (floor,) = struct.unpack("<Q", body)
-            for i in [i for i in n.entries if i <= floor]:
-                del n.entries[i]
+            n.entries.compact(floor)
         elif rectype == R_REMOVE:
             self._nodes[key] = _Node(removed=True)
 
@@ -315,11 +383,9 @@ class TanLogDB(ILogDB):
             n.snapshot = ud.snapshot
         if ud.entries_to_save:
             first = ud.entries_to_save[0].index
-            for i in [i for i in n.entries if i >= first]:
-                del n.entries[i]
-            for ordinal, e in enumerate(ud.entries_to_save):
-                n.entries[e.index] = (fileno, off, ordinal)
-            n.max_index = ud.entries_to_save[-1].index
+            tail = ud.entries_to_save[-1].index
+            n.entries.add(first, tail, fileno, off)
+            n.max_index = tail
             self._file_entries.setdefault(fileno, set()).add(key)
         self._file_meta.setdefault(fileno, set()).add(key)
         n.removed = False
@@ -355,10 +421,7 @@ class TanLogDB(ILogDB):
             if n.state.is_empty() and not n.entries and n.snapshot.is_empty():
                 return None
             first = n.snapshot.index + 1
-            count, i = 0, first
-            while i in n.entries:
-                count += 1
-                i += 1
+            count = n.entries.contiguous_count(first)
             return RaftState(state=n.state, first_index=first,
                              entry_count=count)
 
@@ -371,8 +434,7 @@ class TanLogDB(ILogDB):
             self._append(R_COMPACT, shard_id, replica_id,
                          struct.pack("<Q", index))
             self._sync()
-            for i in [i for i in n.entries if i <= index]:
-                del n.entries[i]
+            n.entries.compact(index)
             self._gc_files()
 
     def compact_entries_to(self, shard_id, replica_id, index):
@@ -385,7 +447,7 @@ class TanLogDB(ILogDB):
         for key, n in self._nodes.items():
             if n.removed:
                 continue
-            for (fileno, _, _) in n.entries.values():
+            for fileno in n.entries.filenos():
                 live.setdefault(fileno, set()).add(key)
         for fileno in self._lognames():
             if fileno == self._active_fileno:

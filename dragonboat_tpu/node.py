@@ -122,6 +122,16 @@ class Node:
         # set by NodeHost for on-disk SMs: stream a live snapshot image
         # to the peer instead of sending the recorded file
         self.stream_snapshot_cb = None
+        # set by NodeHost: dedicated RSM-apply workers
+        # (engine/apply_pool.py; engine.go:1153 applyWorkerMain).  None ->
+        # apply runs inline on the step path (standalone Node usage).
+        self.apply_pool = None
+        # core mutations produced by an async apply (config-change
+        # application, applied-cursor notification): the raft core is
+        # owned by the step thread, so the apply worker posts closures
+        # here and the next step drains them (the channel the reference's
+        # nodeProxy pattern expresses with configChangeC)
+        self._core_notices: list = []
 
         self.peer: Peer | None = None
         self.stopped = False
@@ -192,13 +202,26 @@ class Node:
             # A LIVE SM already applied past the snapshot (kernel-engine
             # eviction rebuilds a Node around the running SM) — recovery
             # would regress it, so it is skipped.
-            if ss is not None and self.sm.get_last_applied() < ss.index:
+            if ss is not None and self.sm.get_last_applied() < ss.index \
+                    and (ss.witness or ss.dummy):
+                # a witness/dummy record has no data file — restore the
+                # RSM bookkeeping only (raft.go:728 makeWitnessSnapshot)
+                self.sm.restore_bookkeeping(ss)
+                self.compacted_to = max(
+                    0, ss.index - self.cfg.compaction_overhead)
+            elif ss is not None and self.sm.get_last_applied() < ss.index:
                 if not ss.filepath or not os.path.exists(ss.filepath):
                     raise RuntimeError(
                         f"shard {self.shard_id} replica {self.replica_id}: "
                         f"snapshot file {ss.filepath!r} (index {ss.index}) "
                         f"is missing — cannot recover")
                 self.sm.recover_from_snapshot(ss.filepath, ss)
+                # crash window between install-recover and shrink: finish
+                # the shrink now (node.go:871-877 — on-disk SM data is in
+                # the SM's own storage once synced)
+                if self.sm.sm_type == pb.StateMachineType.ON_DISK:
+                    self.sm.sync()
+                    self.sm.shrink_recorded_snapshot(ss.filepath)
                 self.sm.members.set(ss.membership)
                 self.sm.last_applied = max(self.sm.last_applied, ss.index)
                 self.sm.last_applied_term = ss.term
@@ -360,6 +383,7 @@ class Node:
             return False
         peer = self.peer
         with self.mu:
+            notices, self._core_notices = self._core_notices, []
             msgs, self.incoming_msgs = self.incoming_msgs, []
             props, self.incoming_proposals = self.incoming_proposals, []
             cc_entry, self.config_change_entry = self.config_change_entry, None
@@ -369,6 +393,10 @@ class Node:
             compact_key, self.compaction_request_key = (
                 self.compaction_request_key, None)
 
+        # 0. core mutations posted by async applies (CC application,
+        # applied-cursor advance) — the step thread owns the core
+        for fn in notices:
+            fn()
         # 1. read index batch (node.go:1296)
         ctx = self.pending_reads.peep()
         if ctx is not None:
@@ -473,11 +501,20 @@ class Node:
             self.pending_reads.add_ready(rtr.system_ctx, rtr.index)
         if ud.ready_to_reads:
             self.pending_reads.applied(self.sm.get_last_applied())
-        # apply committed entries to the RSM
+        # apply committed entries to the RSM — handed to the apply pool
+        # when one is wired so a slow user SM blocks only its own shard
+        # (engine.go:1153-1204 apply workers), else inline
         if ud.committed_entries:
-            self._apply_entries(ud.committed_entries)
-        # auto snapshot (node.go:694 saveSnapshotRequired)
-        if (self.cfg.snapshot_entries > 0
+            if self.apply_pool is not None:
+                ents = ud.committed_entries
+                self.apply_pool.submit(
+                    self.shard_id,
+                    lambda: self._apply_entries(ents, async_core=True))
+            else:
+                self._apply_entries(ud.committed_entries)
+        # auto snapshot (node.go:694 saveSnapshotRequired); on the async
+        # path the apply worker posts the request itself
+        if (self.apply_pool is None and self.cfg.snapshot_entries > 0
                 and self.applied_since_snapshot >= self.cfg.snapshot_entries):
             self._take_snapshot(_SnapshotRequest())
 
@@ -495,7 +532,7 @@ class Node:
             return
         self.send_message(m)
 
-    def _apply_entries(self, entries) -> None:
+    def _apply_entries(self, entries, async_core: bool = False) -> None:
         for e in entries:
             if e.key:
                 self._rl_release(e.key)
@@ -503,16 +540,54 @@ class Node:
         for r in results:
             entry = next(e for e in entries if e.index == r.index)
             if entry.is_config_change():
-                self._on_config_change_applied(entry, r)
+                if async_core:
+                    self._on_cc_applied_async(entry, r)
+                else:
+                    self._on_config_change_applied(entry, r)
             elif r.key:
                 self.pending_proposals.applied(
                     r.key, r.client_id, r.series_id, r.result, r.rejected
                 )
         self.applied_since_snapshot += len(results)
         applied = self.sm.get_last_applied()
-        if self.peer is not None:
+        if async_core:
+            self._post_core_notice(
+                lambda: self.peer is not None
+                and self.peer.notify_raft_last_applied(applied))
+        elif self.peer is not None:
             self.peer.notify_raft_last_applied(applied)
         self.pending_reads.applied(applied)
+        if (async_core and self.cfg.snapshot_entries > 0
+                and self.applied_since_snapshot >= self.cfg.snapshot_entries):
+            with self.mu:
+                if self.snapshot_request is None:
+                    self.snapshot_request = _SnapshotRequest()
+
+    def _post_core_notice(self, fn) -> None:
+        with self.mu:
+            self._core_notices.append(fn)
+
+    def _on_cc_applied_async(self, entry: pb.Entry, r) -> None:
+        """CC applied on an apply worker: the RSM membership store (under
+        its own lock) is already updated; the raft-core notification is
+        posted to the step thread, which owns the core."""
+        cc = pb.decode_config_change(entry.cmd)
+
+        def notice() -> None:
+            if self.peer is None:
+                return
+            if not r.rejected:
+                self.peer.apply_config_change(cc)
+            else:
+                self.peer.reject_config_change()
+
+        self._post_core_notice(notice)
+        if not r.rejected:
+            self.membership_changed_cb(cc)
+        code = (RequestResultCode.REJECTED if r.rejected
+                else RequestResultCode.COMPLETED)
+        self.pending_config_change.done(
+            entry.key, code, Result(value=entry.index))
 
     def _on_config_change_applied(self, entry: pb.Entry, r) -> None:
         cc = pb.decode_config_change(entry.cmd)
@@ -654,6 +729,17 @@ class Node:
             if req.key:
                 self.pending_snapshot.done(req.key, RequestResultCode.REJECTED)
             return
+        if self.cfg.is_witness and not req.exported:
+            # a witness holds no data: record a file-less witness
+            # snapshot (snapshotter.go witness record; raft.go:728) so
+            # compaction keeps working without writing an empty image
+            index, term, membership = self.sm.applied_meta()
+            ss = pb.Snapshot(
+                index=index, term=term, membership=membership,
+                shard_id=self.shard_id, type=self.sm.sm_type, witness=True,
+            )
+            self._record_snapshot(ss, req)
+            return
         path = req.path if req.exported else self._snapshot_path(index0)
         self.fs.makedirs(os.path.dirname(path) or ".")
         index, term, membership = self.sm.save_snapshot(path)
@@ -672,31 +758,42 @@ class Node:
             from dragonboat_tpu.tools import write_export_metadata
 
             write_export_metadata(path, ss, fs=self.fs)
+            self.applied_since_snapshot = 0
+            if req.key:
+                self.pending_snapshot.done(
+                    req.key, RequestResultCode.COMPLETED,
+                    snapshot_index=index)
         else:
-            self.logdb.save_snapshots([pb.Update(
-                shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
-            )])
-            # make the snapshot visible to makeInstallSnapshotMessage
-            # (snapshotter.Commit → logReader.CreateSnapshot)
-            self.log_reader.create_snapshot(ss)
-            self.events.snapshot_created(SnapshotInfo(
-                shard_id=self.shard_id, replica_id=self.replica_id,
-                from_=self.replica_id, index=index, term=term))
-            # compact the log, keeping compaction_overhead entries
-            overhead = (req.compaction_overhead if req.override_compaction
-                        else self.cfg.compaction_overhead)
-            compact_to = max(0, index - overhead)
-            if compact_to > 0 and not self.cfg.disable_auto_compaction:
-                try:
-                    self.log_reader.compact(compact_to)
-                    self.logdb.remove_entries_to(
-                        self.shard_id, self.replica_id, compact_to)
-                    self.compacted_to = compact_to
-                    self.events.log_compacted(EntryInfo(
-                        shard_id=self.shard_id, replica_id=self.replica_id,
-                        index=compact_to))
-                except Exception:
-                    _LOG.exception("log compaction failed")
+            self._record_snapshot(ss, req)
+
+    def _record_snapshot(self, ss: pb.Snapshot, req: _SnapshotRequest) -> None:
+        """Persist the snapshot record + compact the log (node.go:781-801
+        after doSave)."""
+        index = ss.index
+        self.logdb.save_snapshots([pb.Update(
+            shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
+        )])
+        # make the snapshot visible to makeInstallSnapshotMessage
+        # (snapshotter.Commit → logReader.CreateSnapshot)
+        self.log_reader.create_snapshot(ss)
+        self.events.snapshot_created(SnapshotInfo(
+            shard_id=self.shard_id, replica_id=self.replica_id,
+            from_=self.replica_id, index=index, term=ss.term))
+        # compact the log, keeping compaction_overhead entries
+        overhead = (req.compaction_overhead if req.override_compaction
+                    else self.cfg.compaction_overhead)
+        compact_to = max(0, index - overhead)
+        if compact_to > 0 and not self.cfg.disable_auto_compaction:
+            try:
+                self.log_reader.compact(compact_to)
+                self.logdb.remove_entries_to(
+                    self.shard_id, self.replica_id, compact_to)
+                self.compacted_to = compact_to
+                self.events.log_compacted(EntryInfo(
+                    shard_id=self.shard_id, replica_id=self.replica_id,
+                    index=compact_to))
+            except Exception:
+                _LOG.exception("log compaction failed")
         self.applied_since_snapshot = 0
         if req.key:
             self.pending_snapshot.done(
@@ -709,8 +806,23 @@ class Node:
         ss = m.snapshot
         self.peer.raft.handle(m)  # raft-core restore (log + remotes)
         if self.peer.raft.log.inmem.snapshot is not None:
+            if ss.witness or ss.dummy:
+                # witness snapshots carry no data file (raft.go:728
+                # makeWitnessSnapshot): advance the RSM bookkeeping only
+                self.sm.restore_bookkeeping(ss)
+                self.events.snapshot_recovered(SnapshotInfo(
+                    shard_id=self.shard_id, replica_id=self.replica_id,
+                    from_=m.from_, index=ss.index, term=ss.term))
+                return
             # accepted: recover the user SM from the snapshot file
             self.sm.recover_from_snapshot(ss.filepath, ss)
+            # on-disk SM: once the recovered data is synced into the SM's
+            # own storage the recorded file is redundant bytes — shrink
+            # it to the empty-session container (node.go:871-877 Sync +
+            # snapshotter.Shrink)
+            if self.sm.sm_type == pb.StateMachineType.ON_DISK:
+                self.sm.sync()
+                self.sm.shrink_recorded_snapshot(ss.filepath)
             self.events.snapshot_recovered(SnapshotInfo(
                 shard_id=self.shard_id, replica_id=self.replica_id,
                 from_=m.from_, index=ss.index, term=ss.term))

@@ -183,6 +183,15 @@ class NodeHost:
         self._worker_events = [threading.Event()
                                for _ in range(self._num_workers)]
         self._workers: list[threading.Thread] = []
+        # dedicated RSM-apply workers (engine.go:1153 applyWorkerMain): a
+        # slow user SM occupies one of these, never a step worker
+        from dragonboat_tpu.engine.apply_pool import ApplyPool
+
+        # NOT capped by cpu_count: apply workers exist to absorb BLOCKED
+        # user SMs (the reference runs a fixed 16 regardless of cores)
+        self._apply_pool = ApplyPool(
+            num_workers=max(1, min(nhconfig.expert.engine.apply_shards, 16)),
+            on_work_done=self._work.set, name=f"apply-{self.id[:8]}")
         if auto_run:
             self._engine_thread = threading.Thread(
                 target=self._engine_main, name=f"engine-{self.id[:12]}",
@@ -218,6 +227,7 @@ class NodeHost:
             self._engine_thread.join(timeout=5)
         for t in self._workers:
             t.join(timeout=5)
+        self._apply_pool.stop()
         for n in nodes:
             n.destroy()
             self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
@@ -279,6 +289,7 @@ class NodeHost:
             )
             node.stream_snapshot_cb = self._stream_snapshot
             node.notify_commit = self.config.notify_commit
+            node.apply_pool = self._apply_pool
             members = initial_members if not join else {}
             node.start(members, initial=not join, new_node=new_node)
             for rid, addr in (members or {}).items():
@@ -307,6 +318,7 @@ class NodeHost:
             self.mesh_engine.remove_replica(node)
         elif self.kernel_engine is not None:
             self.kernel_engine.remove_shard(shard_id)
+        self._apply_pool.flush(shard_id)
         node.destroy()
         self.events.node_unloaded(NodeInfo(shard_id, node.replica_id))
 
@@ -442,6 +454,7 @@ class NodeHost:
         node.membership_changed_cb = (
             lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc))
         node.stream_snapshot_cb = self._stream_snapshot
+        node.apply_pool = self._apply_pool
         # transplant the books so callers' futures survive the move
         for attr in ("pending_proposals", "pending_reads",
                      "pending_config_change", "pending_snapshot",

@@ -27,6 +27,11 @@ from typing import BinaryIO
 MAGIC = b"DBTPUSNP"
 V2 = 2
 V3 = 3
+# version-field flag: the file is a SHRUNKEN snapshot (snapshotio.go:462
+# ShrinkSnapshot) — a valid container whose payload was dropped because an
+# on-disk SM holds the data durably itself; only the (empty) session image
+# remains.  Recovery must never feed a shrunk payload to a non-on-disk SM.
+SHRUNK = 0x100
 BLOCK_SIZE = 256 * 1024
 # only compress when it actually shrinks the block by a margin (skip
 # incompressible payloads rather than pay decompress for nothing)
@@ -132,11 +137,13 @@ class BlockReader:
 
 
 def write_snapshot(f: BinaryIO, session_data: bytes,
-                   write_payload, compress: bool = False) -> None:
+                   write_payload, compress: bool = False,
+                   shrunk: bool = False) -> None:
     """write_payload(w) receives a BlockWriter for the SM payload."""
     header = struct.pack("<Q", len(session_data))
     f.write(MAGIC)
-    f.write(struct.pack("<I", V3 if compress else V2))
+    version = (V3 if compress else V2) | (SHRUNK if shrunk else 0)
+    f.write(struct.pack("<I", version))
     f.write(struct.pack("<I", zlib.crc32(header)))
     f.write(header)
     f.write(struct.pack("<I", zlib.crc32(session_data)))
@@ -147,10 +154,14 @@ def write_snapshot(f: BinaryIO, session_data: bytes,
 
 
 def read_snapshot(f: BinaryIO):
-    """Returns (session_bytes, BlockReader for the payload)."""
+    """Returns (session_bytes, BlockReader for the payload).  The reader
+    carries ``.shrunk`` — True for a shrunken on-disk-SM snapshot whose
+    payload was dropped (ShrinkSnapshot, snapshotio.go:462)."""
     if f.read(8) != MAGIC:
         raise SnapshotFormatError("bad magic")
     (version,) = struct.unpack("<I", f.read(4))
+    shrunk = bool(version & SHRUNK)
+    version &= ~SHRUNK
     if version not in (V2, V3):
         raise SnapshotFormatError(f"unsupported version {version}")
     (hcrc,) = struct.unpack("<I", f.read(4))
@@ -162,4 +173,30 @@ def read_snapshot(f: BinaryIO):
     session = f.read(slen)
     if zlib.crc32(session) != scrc:
         raise SnapshotFormatError("session checksum mismatch")
-    return session, BlockReader(f, version=version)
+    reader = BlockReader(f, version=version)
+    reader.shrunk = shrunk
+    return session, reader
+
+
+def shrink_snapshot_file(path: str, fs, session_data: bytes = b"") -> None:
+    """Atomically replace a recorded snapshot with its shrunken form: a
+    valid container holding ``session_data`` (normally an empty session
+    image) and zero payload blocks (snapshotio.go:462 ShrinkSnapshot +
+    :486 ReplaceSnapshot)."""
+    tmp = path + ".shrinking"
+    with fs.open(tmp, "wb") as f:
+        write_snapshot(f, session_data, lambda w: None, shrunk=True)
+        fs.fsync(f)
+    fs.replace(tmp, path)
+
+
+def is_shrunk_snapshot(path: str, fs) -> bool:
+    """Header-only check (snapshotter.go Shrunk)."""
+    with fs.open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            return False
+        raw = f.read(4)
+        if len(raw) != 4:
+            return False
+        (version,) = struct.unpack("<I", raw)
+        return bool(version & SHRUNK)

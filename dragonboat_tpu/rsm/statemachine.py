@@ -22,7 +22,12 @@ from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu import statemachine as sm_api
 from dragonboat_tpu.rsm.membership import MembershipStore
 from dragonboat_tpu.rsm.session import LRUSession
-from dragonboat_tpu.rsm.snapshotio import read_snapshot, write_snapshot
+from dragonboat_tpu.rsm.snapshotio import (
+    SnapshotFormatError,
+    read_snapshot,
+    shrink_snapshot_file,
+    write_snapshot,
+)
 
 
 @dataclass
@@ -90,8 +95,12 @@ class StateMachine:
         return self.members.get()
 
     def get_last_applied(self) -> int:
-        with self._mu:
-            return self.last_applied
+        # deliberately lock-free: the applied cursor is a monotonic int
+        # (atomic to read) and the step path polls it every step — taking
+        # _mu here would let a slow user Update() holding the apply lock
+        # wedge the step worker, exactly what the apply pool exists to
+        # prevent (engine.go:1153 apply/step isolation)
+        return self.last_applied
 
     # -- hash oracles for chaos testing (monkey.go:113-121) ---------------
 
@@ -123,6 +132,11 @@ class StateMachine:
             index=e.index, key=e.key, client_id=e.client_id,
             series_id=e.series_id, result=sm_api.Result(),
         )
+        if e.type == pb.EntryType.METADATA:
+            # witness replication strips payloads (raft.go:770
+            # makeMetadataEntries): the entry advances the applied cursor
+            # but must never reach sessions or the user SM
+            return res
         if e.is_config_change():
             cc = pb.decode_config_change(e.cmd)
             accepted = self.members.handle_config_change(cc, e.index)
@@ -237,14 +251,63 @@ class StateMachine:
         with self._mu:
             with self.fs.open(path, "rb") as f:
                 session_data, payload = read_snapshot(f)
+                # a shrunken snapshot carries no payload — the on-disk
+                # SM's own durable storage has the data (statemachine.go
+                # :295 isShrunkSnapshot skip); feeding it to any other SM
+                # kind would silently lose state
+                shrunk = getattr(payload, "shrunk", False)
+                if shrunk and self.sm_type != pb.StateMachineType.ON_DISK:
+                    raise SnapshotFormatError(
+                        "shrunk snapshot on a non-on-disk SM")
+                if shrunk and self.last_applied < ss.index:
+                    # the payload was dropped on the assumption the
+                    # receiver's own durable storage covers ss.index —
+                    # if it doesn't (a lagging peer was handed a shrunk
+                    # file), skipping silently would fake an applied
+                    # cursor over data that never arrived
+                    raise SnapshotFormatError(
+                        f"shrunk snapshot at index {ss.index} does not "
+                        f"cover this SM (applied {self.last_applied})")
                 self.sessions = LRUSession.load(io.BytesIO(session_data))
-                if self.sm_type == pb.StateMachineType.ON_DISK:
-                    self.sm.recover_from_snapshot(payload, lambda: False)
-                else:
-                    self.sm.recover_from_snapshot(payload, (), lambda: False)
+                if not shrunk:
+                    if self.sm_type == pb.StateMachineType.ON_DISK:
+                        self.sm.recover_from_snapshot(payload, lambda: False)
+                    else:
+                        self.sm.recover_from_snapshot(payload, (),
+                                                      lambda: False)
             self.members.set(ss.membership)
             self.last_applied = ss.index
             self.last_applied_term = ss.term
+
+    def restore_bookkeeping(self, ss: pb.Snapshot) -> None:
+        """Advance membership + applied meta WITHOUT touching the user SM
+        — the restore path for file-less witness/dummy snapshots
+        (raft.go:728 makeWitnessSnapshot carries no data)."""
+        with self._mu:
+            self.members.set(ss.membership)
+            self.last_applied = max(self.last_applied, ss.index)
+            self.last_applied_term = ss.term
+
+    def applied_meta(self) -> tuple[int, int, "pb.Membership"]:
+        """(applied index, term, membership) as one consistent read."""
+        with self._mu:
+            return self.last_applied, self.last_applied_term, \
+                self.members.get()
+
+    def sync(self) -> None:
+        """On-disk SM durability barrier (disk.go Sync)."""
+        if self.sm_type == pb.StateMachineType.ON_DISK:
+            self.sm.sync()
+
+    def shrink_recorded_snapshot(self, path: str) -> None:
+        """Replace the recorded snapshot file with its shrunken form once
+        an on-disk SM has synced the data into its own storage
+        (snapshotter.go:200 Shrink).  No-op for other SM kinds."""
+        if self.sm_type != pb.StateMachineType.ON_DISK:
+            return
+        sbuf = io.BytesIO()
+        LRUSession().save(sbuf)
+        shrink_snapshot_file(path, self.fs, sbuf.getvalue())
 
     def close(self) -> None:
         self.sm.close()

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Opportunistic TPU measurement: probe the (flaky) device tunnel in a
+subprocess; when it is alive, IMMEDIATELY measure kernel step time at an
+ascending group ladder, appending one JSON line per config to
+PERF_TPU.jsonl — so a revived tunnel is never wasted on a compile that
+outlives it.  Small shapes first: every completed rung is a recorded
+datapoint even if the tunnel dies mid-ladder.
+
+Usage: python scripts/tpu_grab.py [--ladder 256,1024,4096,8192]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "PERF_TPU.jsonl")
+
+RUNG = r"""
+import os, sys, time, json
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/dragonboat_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+plat = jax.devices()[0].platform
+from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps, elect_all
+G = {g}
+kp = bench_params(3)
+t0 = time.time()
+state = elect_all(kp, 3, make_cluster(kp, G, 3))
+jax.block_until_ready(state.term)
+setup_s = time.time() - t0
+t0 = time.time()
+state = run_steps(kp, 3, 4, state)
+jax.block_until_ready(state.term)
+compile_s = time.time() - t0
+t0 = time.time()
+N = {steps}
+state = run_steps(kp, 3, N, state)
+jax.block_until_ready(state.term)
+dt = time.time() - t0
+wps = {g} * 28 / (dt / N)   # 28 committed writes per group-step (bench width)
+print("RUNG " + json.dumps({{
+    "ts": time.time(), "platform": plat, "groups": G,
+    "setup_s": round(setup_s, 1), "compile_s": round(compile_s, 1),
+    "step_ms": round(dt / N * 1000, 3), "writes_per_s": int(wps),
+}}))
+"""
+
+
+def probe(timeout: float = 60.0) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and "tpu" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    ladder = [int(x) for x in (
+        sys.argv[sys.argv.index("--ladder") + 1].split(",")
+        if "--ladder" in sys.argv else ["256", "1024", "4096", "8192"])]
+    if not probe():
+        print(json.dumps({"ts": time.time(), "probe": "wedged"}))
+        return
+    print("tunnel alive; measuring", flush=True)
+    for g in ladder:
+        steps = max(20, min(100, 200_000 // g))
+        code = RUNG.format(repo=REPO, g=g, steps=steps)
+        # generous per-rung timeout: compile at new shapes is slow over
+        # the tunnel, but a wedge must not eat the whole session
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            rec = {"ts": time.time(), "groups": g, "error": "rung timeout"}
+            with open(OUT, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            print(json.dumps(rec), flush=True)
+            break
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("RUNG ")), None)
+        if line is None:
+            rec = {"ts": time.time(), "groups": g,
+                   "error": (r.stderr or "no output")[-500:]}
+        else:
+            rec = json.loads(line[5:])
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if "error" in rec:
+            break
+
+
+if __name__ == "__main__":
+    main()

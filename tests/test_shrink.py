@@ -1,0 +1,233 @@
+"""Shrunken snapshots for on-disk SMs (snapshotter.go:200 Shrink,
+snapshotio.go:462 ShrinkSnapshot): after an on-disk SM recovers an
+installed snapshot and syncs, the recorded file is replaced by a tiny
+valid container (empty sessions, no payload); recovery recognizes the
+shrunken form and never feeds it to the SM."""
+
+import io
+import json
+import os
+import struct
+import time
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.rsm.snapshotio import (
+    SnapshotFormatError,
+    is_shrunk_snapshot,
+    read_snapshot,
+    shrink_snapshot_file,
+    write_snapshot,
+)
+from dragonboat_tpu.rsm.statemachine import StateMachine
+from dragonboat_tpu.statemachine import IOnDiskStateMachine, \
+    IStateMachine, Result
+from dragonboat_tpu.vfs import default_fs
+
+from test_nodehost import wait_leader
+
+
+class DurableDiskKV(IOnDiskStateMachine):
+    """A REAL on-disk SM: state persists to a json file; open() recovers
+    it — so a restart after shrink must come back with the data."""
+
+    root = "/tmp/shrink-test"  # overridden per-test
+
+    def __init__(self, shard_id=0, replica_id=0):
+        self.path = os.path.join(self.root, f"sm-{shard_id}-{replica_id}.json")
+        self.kv = {}
+        self.applied = 0
+
+    def open(self, stopc):
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                d = json.load(f)
+            self.kv, self.applied = d["kv"], d["applied"]
+        return self.applied
+
+    def update(self, entries):
+        out = []
+        for e in entries:
+            k, v = e.cmd.decode().split("=", 1)
+            self.kv[k] = v
+            self.applied = e.index
+            out.append(type(e)(index=e.index, cmd=e.cmd,
+                               result=Result(value=len(self.kv))))
+        self.sync()
+        return out
+
+    def lookup(self, q):
+        return self.kv.get(q)
+
+    def sync(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"kv": self.kv, "applied": self.applied}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def prepare_snapshot(self):
+        return dict(self.kv), self.applied
+
+    def save_snapshot(self, ctx, w, done):
+        kv, applied = ctx
+        d = json.dumps({"kv": kv, "applied": applied}).encode()
+        w.write(struct.pack("<I", len(d)))
+        w.write(d)
+
+    def recover_from_snapshot(self, r, done):
+        (n,) = struct.unpack("<I", r.read(4))
+        d = json.loads(r.read(n).decode())
+        self.kv, self.applied = d["kv"], d["applied"]
+        self.sync()
+
+
+class MemKV(IStateMachine):
+    def __init__(self, *a):
+        self.kv = {}
+
+    def update(self, entry):
+        k, v = entry.cmd.decode().split("=", 1)
+        self.kv[k] = v
+        return Result(value=len(self.kv))
+
+    def lookup(self, q):
+        return self.kv.get(q)
+
+    def save_snapshot(self, w, files, done):
+        d = json.dumps(self.kv).encode()
+        w.write(struct.pack("<I", len(d)))
+        w.write(d)
+
+    def recover_from_snapshot(self, r, files, done):
+        (n,) = struct.unpack("<I", r.read(4))
+        self.kv = json.loads(r.read(n).decode())
+
+
+def test_shrink_file_roundtrip(tmp_path):
+    """shrink_snapshot_file replaces a full container with a valid,
+    recognizably-shrunk one."""
+    p = str(tmp_path / "snap.bin")
+    fs = default_fs()
+    with open(p, "wb") as f:
+        write_snapshot(f, b"SESSIONS", lambda w: w.write(b"x" * 100_000))
+    full_size = os.path.getsize(p)
+    assert not is_shrunk_snapshot(p, fs)
+    shrink_snapshot_file(p, fs, session_data=b"")
+    assert is_shrunk_snapshot(p, fs)
+    assert os.path.getsize(p) < 64 < full_size
+    with open(p, "rb") as f:
+        session, payload = read_snapshot(f)
+        assert payload.shrunk
+        assert payload.read() == b""
+
+
+def test_recover_from_shrunk_skips_payload(tmp_path):
+    """An on-disk SM recovering a shrunk file keeps the data its own
+    storage already holds; the payload is not touched."""
+    DurableDiskKV.root = str(tmp_path)
+    sm = StateMachine(1, 1, DurableDiskKV(1, 1))
+    for i in range(10):
+        sm.handle([pb.Entry(term=1, index=i + 1, cmd=f"k{i}=v{i}".encode())])
+    path = str(tmp_path / "snap.bin")
+    index, term, membership = sm.save_snapshot(path)
+    sm.shrink_recorded_snapshot(path)
+    assert is_shrunk_snapshot(path, default_fs())
+
+    # a fresh orchestrator around a fresh (durable) SM: open() recovers
+    # the data; the shrunk snapshot recovery only restores meta/sessions
+    sm2 = StateMachine(1, 1, DurableDiskKV(1, 1))
+    assert sm2.get_last_applied() == 10
+    ss = pb.Snapshot(index=index, term=term, membership=membership)
+    sm2.recover_from_snapshot(path, ss)
+    assert sm2.get_last_applied() == 10
+    assert sm2.lookup("k9") == "v9"
+
+
+def test_shrunk_file_rejected_for_regular_sm(tmp_path):
+    p = str(tmp_path / "snap.bin")
+    sm = StateMachine(1, 1, MemKV())
+    sm.handle([pb.Entry(term=1, index=1, cmd=b"a=b")])
+    sm.save_snapshot(p)
+    shrink_snapshot_file(p, default_fs(), b"")
+    sm2 = StateMachine(1, 1, MemKV())
+    with pytest.raises(SnapshotFormatError):
+        sm2.recover_from_snapshot(p, pb.Snapshot(index=1, term=1))
+
+
+def test_shrink_noop_for_regular_sm(tmp_path):
+    p = str(tmp_path / "snap.bin")
+    sm = StateMachine(1, 1, MemKV())
+    sm.handle([pb.Entry(term=1, index=1, cmd=b"a=b")])
+    sm.save_snapshot(p)
+    sm.shrink_recorded_snapshot(p)  # no-op: not on-disk
+    assert not is_shrunk_snapshot(p, default_fs())
+
+
+def test_installed_snapshot_shrinks_then_restart_keeps_data(tmp_path):
+    """E2E: a lagging on-disk replica catches up via snapshot install;
+    its recorded snapshot file ends up shrunk (node.go:871-877), and a
+    full restart of that host still serves the data (the SM's own
+    storage is the source of truth)."""
+    DurableDiskKV.root = str(tmp_path / "sms")
+    addrs = {i: f"shrink-{time.monotonic_ns()}-{i}" for i in (1, 2, 3)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=5))
+        nh.start_replica(addrs, False, DurableDiskKV, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[rid] = nh
+    try:
+        lid = wait_leader(hosts)
+        lagger = next(r for r in hosts if r != lid)
+        hosts[lagger].close()
+        del hosts[lagger]
+        s = hosts[lid].get_noop_session(1)
+        for i in range(30):
+            hosts[lid].sync_propose(s, f"d{i}=v{i}".encode())
+        nh2 = NodeHost(NodeHostConfig(raft_address=addrs[lagger],
+                                      rtt_millisecond=5))
+        nh2.start_replica(addrs, False, DurableDiskKV, Config(
+            shard_id=1, replica_id=lagger, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[lagger] = nh2
+        deadline = time.time() + 15
+        while time.time() < deadline and nh2.stale_read(1, "d29") != "v29":
+            time.sleep(0.05)
+        assert nh2.stale_read(1, "d29") == "v29"
+
+        # the installed snapshot record on the lagger must be shrunk
+        fs = default_fs()
+        deadline = time.time() + 10
+        ss = None
+        while time.time() < deadline:
+            ss = nh2.logdb.get_snapshot(1, lagger)
+            if ss is not None and ss.filepath \
+                    and os.path.exists(ss.filepath) \
+                    and is_shrunk_snapshot(ss.filepath, fs):
+                break
+            time.sleep(0.05)
+        assert ss is not None and is_shrunk_snapshot(ss.filepath, fs), \
+            "installed snapshot was not shrunk"
+
+        # restart the lagger: data must come back from the SM's own
+        # storage, not the (payload-less) snapshot file
+        hosts[lagger].close()
+        del hosts[lagger]
+        nh3 = NodeHost(NodeHostConfig(raft_address=addrs[lagger],
+                                      rtt_millisecond=5))
+        nh3.start_replica(addrs, False, DurableDiskKV, Config(
+            shard_id=1, replica_id=lagger, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[lagger] = nh3
+        assert nh3.stale_read(1, "d29") == "v29"
+        assert nh3.stale_read(1, "d0") == "v0"
+    finally:
+        for h in hosts.values():
+            h.close()
