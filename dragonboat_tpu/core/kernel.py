@@ -863,29 +863,53 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     prop_vals = (inp.prop_val if inp.prop_val is not None
                  else jnp.zeros_like(inp.prop_cc, I32))
 
-    def _scan_prop(carry, pv):
-        s_, eff_, appended = carry
-        v_, is_cc_, val_ = pv
-        # ring-capacity guard: refuse proposals that would overflow the term
-        # ring (host sees prop_accepted=False → system busy, mirroring the
-        # reference's in-mem log rate limiting; compaction frees space)
-        room = (s_.last + 1 - s_.snap_index) <= kp.log_cap
-        v_ = v_ & can_prop & room
-        # one-at-a-time config change: drop CC while one is pending
-        cc_ok = v_ & is_cc_ & ~s_.pending_cc
-        drop_cc = v_ & is_cc_ & s_.pending_cc
-        do = v_ & (~is_cc_ | cc_ok)
-        s_ = _append_one(kp, s_, do, s_.term, is_cc_ & cc_ok, val_)
-        s_ = mrep(s_, cc_ok, pending_cc=True)
-        eff_ = eff_._replace(save_from=sel(
-            do, jnp.minimum(eff_.save_from, s_.last), eff_.save_from))
-        return (s_, eff_, appended | do), (
-            do & ~drop_cc, sel(do, s_.last, 0), sel(do, s_.term, 0))
-
-    (s, eff, appended_any), (prop_accepted, prop_index, prop_term) = jax.lax.scan(
-        _scan_prop, (s, eff, jnp.asarray(False)),
-        (inp.prop_valid, inp.prop_cc, prop_vals),
+    # Closed-form batch append — this was a B-iteration lax.scan, and
+    # serial loops are poison on TPU (every iteration is its own tiny
+    # launch over the whole [G] state).  The scan's slot-order semantics
+    # are reproduced exactly:
+    #  - ring-capacity guard: `last` advances per accept and the room
+    #    check is monotone within a batch, so capping the accept RANK at
+    #    the remaining room cuts the same suffix the per-slot check did
+    #    (host sees prop_accepted=False → system busy; compaction frees
+    #    space — the reference's in-mem log rate limiting);
+    #  - one-at-a-time config change: only the first CC candidate lands
+    #    while none is pending; later CCs in the batch drop.
+    v0 = inp.prop_valid & can_prop                           # [B]
+    cc_cand = v0 & inp.prop_cc & ~s.pending_cc
+    cc_first = cc_cand & (jnp.cumsum(cc_cand.astype(I32)) == 1)
+    do1 = v0 & (~inp.prop_cc | cc_first)
+    m_max = kp.log_cap - (s.last - s.snap_index)             # ring room left
+    do = do1 & (jnp.cumsum(do1.astype(I32)) <= m_max)
+    rank = jnp.cumsum(do.astype(I32))                        # 1-based
+    n_total = rank[-1]
+    appended_any = n_total > 0
+    prop_accepted = do
+    prop_index = sel(do, s.last + rank, 0)
+    prop_term = sel(do, jnp.broadcast_to(s.term, do.shape), 0)
+    # compress accepted slots by rank: off j holds (is_cc, val) of the
+    # rank-(j+1) accept — the ring write below reads by offset
+    B = do.shape[0]
+    rank_onehot = (rank[None, :] == (jnp.arange(B, dtype=I32) + 1)[:, None]) \
+        & do[None, :]                                        # [B(off), B(slot)]
+    cc_by_off = jnp.any(rank_onehot & cc_first[None, :], axis=1)
+    val_by_off = jnp.sum(rank_onehot * prop_vals[None, :], axis=1)
+    # one pass over the ring: position p hosts unwrapped index base+off;
+    # n_total <= B << log_cap, so the append window never self-wraps
+    base = s.last + 1
+    pos = jnp.arange(kp.log_cap, dtype=I32)
+    off = (pos - (base & (kp.log_cap - 1))) & (kp.log_cap - 1)
+    in_win = off < n_total
+    off_c = jnp.minimum(off, B - 1)
+    s = s._replace(
+        lt=sel(in_win, jnp.broadcast_to(s.term, pos.shape), s.lt),
+        lcc=sel(in_win, cc_by_off[off_c], s.lcc),
+        last=s.last + n_total,
+        pending_cc=s.pending_cc | jnp.any(do & cc_first),
     )
+    if kp.inline_payloads:
+        s = s._replace(lv=sel(in_win, val_by_off[off_c], s.lv))
+    eff = eff._replace(save_from=sel(
+        appended_any, jnp.minimum(eff.save_from, base), eff.save_from))
     self_mask = _self_slot_mask(s)
     s = s._replace(
         match=sel(appended_any & self_mask, s.last, s.match),

@@ -97,6 +97,10 @@ class ApplyPool:
                     if self._queues.get(key):
                         self._ready.append(key)
                         self._cv.notify()
+                    else:
+                        # retired/idle keys must not leak a dict slot
+                        # per shard forever (100k-group scale)
+                        self._queues.pop(key, None)
                     self._cv.notify_all()  # wake flush() waiters
             if self._on_work_done is not None:
                 self._on_work_done()

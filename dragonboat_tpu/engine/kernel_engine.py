@@ -756,9 +756,15 @@ class KernelEngine:
             if o["s_wit_snap"][g, p]:
                 # witness peer fell behind compaction: answer with the
                 # stripped file-less snapshot built from the recorded
-                # snapshot (raft.go:713-735) — no stream, no eviction
+                # snapshot (raft.go:713-735) — no stream, no eviction.
+                # The record must cover the DEVICE compaction floor: the
+                # device paused the peer at psnap = snap_index, and a
+                # stale older record would leave a gap the witness can
+                # never bridge (re-sent forever) — evict instead.
                 ss = n.logdb.get_snapshot(n.shard_id, n.replica_id)
-                if ss is not None and not ss.is_empty():
+                floor = int(self.state.snap_index[g])  # rare: wit_snap only
+                if ss is not None and not ss.is_empty() \
+                        and ss.index >= floor:
                     others.append((n, pb.Message(
                         type=MT.INSTALL_SNAPSHOT, to=to,
                         from_=n.replica_id, shard_id=shard,
@@ -768,8 +774,8 @@ class KernelEngine:
                             witness=True, dummy=False),
                     )))
                 else:
-                    # nothing recorded to serve from — the regular
-                    # escalation path handles it
+                    # no record, or one below the device floor — the
+                    # regular escalation path recovers the shard
                     self._wit_snap_fallback.add(n.shard_id)
             if o["s_hb"][g, p]:
                 others.append((n, pb.Message(

@@ -436,9 +436,18 @@ class Node:
         if transfer is not None:
             self.qs.record(pb.MessageType.LEADER_TRANSFER)
             self._start_leader_transfer(transfer)
-        # 6. snapshot request
+        # 6. snapshot request — on the apply pool when one is wired:
+        # save_snapshot takes the SM apply lock, and a wedged user SM
+        # holding it must never block the step worker (the reference
+        # takes snapshots on dedicated workers too, engine.go snapshot
+        # workers); per-shard pool order also serializes it with applies
         if ss_req is not None:
-            self._take_snapshot(ss_req)
+            if self.apply_pool is not None:
+                req = ss_req
+                self.apply_pool.submit(
+                    self.shard_id, lambda: self._take_snapshot(req))
+            else:
+                self._take_snapshot(ss_req)
         # 7. raft log query (node.go:1238 handleLogQuery)
         if lq is not None:
             peer.query_raft_log(*lq)
@@ -548,7 +557,11 @@ class Node:
                 self.pending_proposals.applied(
                     r.key, r.client_id, r.series_id, r.result, r.rejected
                 )
-        self.applied_since_snapshot += len(results)
+        with self.mu:
+            # incremented here (apply worker) and reset by
+            # _record_snapshot (possibly another thread) — racing the +=
+            # against the reset would lose the reset and double-snapshot
+            self.applied_since_snapshot += len(results)
         applied = self.sm.get_last_applied()
         if async_core:
             self._post_core_notice(
@@ -758,7 +771,8 @@ class Node:
             from dragonboat_tpu.tools import write_export_metadata
 
             write_export_metadata(path, ss, fs=self.fs)
-            self.applied_since_snapshot = 0
+            with self.mu:
+                self.applied_since_snapshot = 0
             if req.key:
                 self.pending_snapshot.done(
                     req.key, RequestResultCode.COMPLETED,
@@ -794,7 +808,8 @@ class Node:
                     index=compact_to))
             except Exception:
                 _LOG.exception("log compaction failed")
-        self.applied_since_snapshot = 0
+        with self.mu:
+            self.applied_since_snapshot = 0
         if req.key:
             self.pending_snapshot.done(
                 req.key, RequestResultCode.COMPLETED, snapshot_index=index)

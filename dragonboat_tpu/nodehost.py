@@ -227,6 +227,12 @@ class NodeHost:
             self._engine_thread.join(timeout=5)
         for t in self._workers:
             t.join(timeout=5)
+        # drain in-flight applies before destroying SMs: sm.close() must
+        # not run concurrently with its own update()
+        for n in nodes:
+            if not self._apply_pool.flush(n.shard_id, timeout=5):
+                _LOG.warning("shard %d: apply still running at close",
+                             n.shard_id)
         self._apply_pool.stop()
         for n in nodes:
             n.destroy()

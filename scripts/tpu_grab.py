@@ -31,16 +31,16 @@ from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps, ele
 G = {g}
 kp = bench_params(3)
 t0 = time.time()
-state = elect_all(kp, 3, make_cluster(kp, G, 3))
+state, box = elect_all(kp, 3, make_cluster(kp, G, 3))
 jax.block_until_ready(state.term)
 setup_s = time.time() - t0
 t0 = time.time()
-state = run_steps(kp, 3, 4, state)
+state, box = run_steps(kp, 3, 4, True, True, state, box)
 jax.block_until_ready(state.term)
 compile_s = time.time() - t0
 t0 = time.time()
 N = {steps}
-state = run_steps(kp, 3, N, state)
+state, box = run_steps(kp, 3, N, True, True, state, box)
 jax.block_until_ready(state.term)
 dt = time.time() - t0
 wps = {g} * 28 / (dt / N)   # 28 committed writes per group-step (bench width)

@@ -78,11 +78,16 @@ def test_slow_sm_does_not_stall_sibling_shard():
         expert=ExpertConfig(engine=EngineConfig(exec_shards=1,
                                                 apply_shards=2))))
     try:
+        # snapshot_entries on the SLOW shard: the auto-snapshot its
+        # applies trigger must also run on the apply pool — taking it on
+        # the step thread would block on the SM lock the wedged update()
+        # holds, re-stalling the sibling
         for shard, sm in ((1, SlowSM), (2, FastSM)):
             nh.start_replica(
                 {1: addr}, False, sm,
                 Config(shard_id=shard, replica_id=1, election_rtt=10,
-                       heartbeat_rtt=1))
+                       heartbeat_rtt=1, snapshot_entries=3,
+                       compaction_overhead=1))
         _wait_ready(nh, 1)
         _wait_ready(nh, 2)
         s1 = Session.new_noop_session(1)

@@ -97,36 +97,69 @@ def test_witness_ack_sustains_commit_quorum():
             nh.close()
 
 
-def test_lagging_witness_gets_stripped_snapshot_without_eviction():
-    """Partition the witness, run the leader past compaction, heal: the
-    kernel leader answers with a file-less witness snapshot and stays
-    device-resident; the witness resumes following."""
-    hosts, lid = _witness_cluster(f"ks-{time.monotonic_ns()}",
-                                  snapshot_entries=8)
+def test_witness_added_after_compaction_gets_stripped_snapshot():
+    """A witness that joins AFTER the leader's device ring compacted
+    (match=0 < device snap floor) is served a file-less stripped
+    snapshot by the kernel leader (raft.go:713-735) — no stream, no
+    eviction — and then follows via metadata replication.
+
+    The witness must be added after compaction: while a witness is
+    merely partitioned, the device ring floor waits for every present
+    peer's match, so the s_wit_snap path would never fire (the earlier
+    version of this test asserted catch-up that plain replication
+    provided)."""
+    prefix = f"ks-{time.monotonic_ns()}"
+    addrs = {1: f"{prefix}-1", 2: f"{prefix}-2"}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = _mk_host(addr)
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=2,
+            snapshot_entries=8, compaction_overhead=2,
+            device_resident=True))
+        hosts[rid] = nh
     try:
+        lid = wait_leader(hosts, timeout=30.0)
         s = hosts[lid].get_noop_session(1)
-        propose_retry(hosts[lid], s, b"w0=v0")
-        hosts[3].partition_node()
-        for i in range(40):  # well past snapshot_entries + overhead
+        for i in range(60):
             propose_retry(hosts[lid], s, f"p{i}=v{i}".encode())
-        # wait until the leader actually compacted below the witness
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            rs = hosts[lid].logdb.read_raft_state(1, lid, 0)
-            if rs is not None and rs.first_index > 5:
-                break
-            time.sleep(0.05)
-        hosts[3].restore_partitioned_node()
-        # witness catches up via the stripped snapshot + metadata tail
-        wnode = hosts[3]._node(1)
+        # wait for the leader LANE's device ring to actually compact
+        eng = hosts[lid].kernel_engine
+        lane = eng.by_shard[1].lane
         deadline = time.time() + 15
         while time.time() < deadline:
-            if wnode.sm.get_last_applied() >= 40:
+            if int(eng.state.snap_index[lane]) > 0:
                 break
+            propose_retry(hosts[lid], s, b"more=x")
             time.sleep(0.05)
-        assert wnode.sm.get_last_applied() >= 40, \
-            "witness never caught up after partition heal"
-        # and the leader never left the kernel
+        assert int(eng.state.snap_index[lane]) > 0, \
+            "device ring never compacted; test cannot exercise wit_snap"
+
+        # NOW add the witness: its match=0 is below the device floor,
+        # so replication to it must go through the stripped snapshot
+        waddr = f"{prefix}-w"
+        propose_retry(hosts[lid], s, b"pre=add")
+        hosts[lid].sync_request_add_witness(1, 3, waddr, 0, timeout_s=10.0)
+        wnh = _mk_host(waddr)
+        wnh.start_replica({}, True, KVStateMachine, Config(
+            shard_id=1, replica_id=3, election_rtt=10, heartbeat_rtt=2,
+            is_witness=True, compaction_overhead=2))
+        hosts["w"] = wnh
+        wnode = wnh._node(1)
+        target = hosts[lid]._node(1).sm.get_last_applied()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if wnode.sm.get_last_applied() >= target:
+                break
+            propose_retry(hosts[lid], s, b"tick=t")
+            time.sleep(0.1)
+        assert wnode.sm.get_last_applied() >= target, \
+            "witness never caught up past the compaction gap"
+        # caught up via a WITNESS snapshot record, not a data file
+        wss = wnh.logdb.get_snapshot(1, 3)
+        assert wss is not None and wss.witness, \
+            "witness snapshot record missing — catch-up used another path"
+        # the leader never left the kernel
         assert 1 in hosts[lid].kernel_engine.by_shard, \
             "kernel leader was evicted serving a witness snapshot"
         assert wnode.sm.sm.kv == {}
