@@ -897,7 +897,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     # n_total <= B << log_cap, so the append window never self-wraps
     base = s.last + 1
     pos = jnp.arange(kp.log_cap, dtype=I32)
-    off = (pos - (base & (kp.log_cap - 1))) & (kp.log_cap - 1)
+    off = (pos - _slot(kp, base)) & (kp.log_cap - 1)
     in_win = off < n_total
     off_c = jnp.minimum(off, B - 1)
     s = s._replace(

@@ -123,6 +123,14 @@ class MeshEngine(KernelEngine):
             raise ValueError(
                 f"mesh-resident shard {node.shard_id}: replica ids {rids} "
                 f"outside mesh addressing 1..{self.spec.replicas}")
+        if any(kind == KP.K_WITNESS for _, kind in init.peers):
+            # admission-time twin of the update_lane_membership guard: a
+            # restart rebuilds init.peers from the durable membership, and
+            # a witness member must keep the group on the host engines
+            # (its mesh row would be ABSENT — traffic to it vanishes)
+            raise ValueError(
+                f"mesh-resident shard {node.shard_id}: witness members "
+                f"are host-engine only")
         with self.mu:
             lane = self._lane_of.get(node.shard_id)
             if lane is None:
@@ -249,6 +257,13 @@ class MeshEngine(KernelEngine):
                 or any(not (1 <= r <= self.spec.replicas) for r in ids)):
             self._evict(node, reason=f"membership {sorted(ids)} outside "
                                      f"mesh addressing")
+            return
+        if m.witnesses:
+            # witness replicas are never mesh-resident (their row stays
+            # ABSENT), so mesh-routed traffic to them would vanish and
+            # the ring floor would wait on their match forever — the
+            # group serves witnesses from the host engines instead
+            self._evict(node, reason="witness member on a mesh group")
             return
         pids = np.zeros((kp.num_peers,), np.int32)
         kinds = np.zeros((kp.num_peers,), np.int32)

@@ -265,3 +265,87 @@ def test_mesh_restart_from_disk(tmp_path):
         assert hosts[lid].sync_read(1, "dz", timeout_s=10) == "zz"
     finally:
         close_all(hosts)
+
+
+def test_mesh_group_with_witness_member_escalates_to_host(tmp_path):
+    """Witness replicas are never mesh-resident, so a mesh group that
+    gains a witness member must leave the mesh (host engines serve
+    witnesses); staying would blackhole all witness-bound traffic.
+
+    The mesh is (g=2, r=4) so witness id 4 is INSIDE mesh addressing —
+    only the witness-specific guard can evict.  The restart then checks
+    the admission-time twin: rebuilding from the durable membership must
+    refuse the mesh and fall back host-side."""
+    prefix = f"mshW{time.monotonic_ns()}"
+    spec = MeshSpec(name=prefix, g_size=2, replicas=4, n_local=2)
+    addrs = {i: f"{prefix}-{i}" for i in (1, 2, 3)}
+    dirs = {i: str(tmp_path / f"nh{i}") for i in (1, 2, 3)}
+    def mk(rid):
+        nh = NodeHost(NodeHostConfig(
+            raft_address=addrs[rid], rtt_millisecond=5,
+            node_host_dir=dirs[rid],
+            expert=ExpertConfig(mesh=spec, kernel_log_cap=256,
+                                kernel_apply_batch=16,
+                                kernel_compaction_overhead=16)))
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=2,
+            mesh_resident=True))
+        return nh
+    hosts = {rid: mk(rid) for rid in (1, 2, 3)}
+    try:
+        lid = wait_leader(hosts, timeout=60)
+        nh = hosts[lid]
+        assert (1, lid) in nh.mesh_engine.by_shard  # really on the mesh
+        propose_retry(nh, nh.get_noop_session(1), b"pre=wit")
+        waddr = f"{prefix}-w"
+        deadline = time.time() + 30
+        while True:
+            try:
+                nh.sync_request_add_witness(1, 4, waddr, 0, timeout_s=5)
+                break
+            except (RequestDroppedError, RequestTimeoutError):
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if all((1, r) not in nh.mesh_engine.by_shard for r in (1, 2, 3)):
+                break
+            time.sleep(0.05)
+        assert all((1, r) not in nh.mesh_engine.by_shard for r in (1, 2, 3)), \
+            "group with witness member stayed mesh-resident"
+        # and it keeps serving from the host engines
+        deadline = time.time() + 40
+        ok = False
+        while time.time() < deadline and not ok:
+            try:
+                nh2 = hosts[wait_leader(hosts, timeout=10)]
+                nh2.sync_propose(nh2.get_noop_session(1), b"post=wit",
+                                 timeout_s=3)
+                ok = nh2.sync_read(1, "post", timeout_s=3) == "wit"
+            except Exception:
+                time.sleep(0.2)
+        assert ok
+    finally:
+        close_all(hosts)
+
+    # restart: the durable membership carries the witness.  If the
+    # recovered snapshot captured it, admission refuses the mesh
+    # outright; otherwise the witness CC replays through the lane apply
+    # within the first steps and the update-time guard evicts.  Either
+    # way the shard must settle host-side, not stay a mesh blackhole.
+    nh3 = mk(1)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if (nh3.mesh_engine is None
+                    or (1, 1) not in nh3.mesh_engine.by_shard) \
+                    and nh3.nodes[1].peer is not None:
+                break
+            time.sleep(0.05)
+        assert nh3.mesh_engine is None \
+            or (1, 1) not in nh3.mesh_engine.by_shard, \
+            "witness-bearing group stayed mesh-resident after restart"
+        assert nh3.nodes[1].peer is not None  # host-resident
+    finally:
+        nh3.close()
