@@ -820,6 +820,11 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
             s_, eff_, r = _process_family(kp, _fam, s_, eff_, m)
             return (s_, eff_), tuple(r)
 
+        # NOTE: unrolling this scan (lax.scan unroll=) is bitwise-safe
+        # but blows XLA compile time up by an order of magnitude (the
+        # inflated body stalls constant folding) — measured 2026-07-30;
+        # keep it rolled unless TPU profiling shows the loop overhead
+        # dominating AND compile budget allows.
         (s, eff), part = jax.lax.scan(_scan_msg, (s, eff), sub)
         r_parts.append(part)
     r_stack = tuple(

@@ -397,6 +397,11 @@ class KernelEngine:
         self.state = s._replace(
             pid=s.pid.at[g].set(jnp.asarray(pids)),
             kind=s.kind.at[g].set(jnp.asarray(kinds)),
+            # the applied CC releases the one-in-flight gate (pycore
+            # add_node/add_non_voting/... clear pending_config_change on
+            # apply; without this a lane accepts exactly ONE config
+            # change in its lifetime and drops every later one)
+            pending_cc=s.pending_cc.at[g].set(False),
         )
         self._kind_np[g] = kinds
 

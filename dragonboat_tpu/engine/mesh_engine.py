@@ -98,6 +98,9 @@ class MeshEngine(KernelEngine):
         self._cut_dev = None
         # group-lane bookkeeping
         self._lane_of: dict[int, int] = {}            # shard_id -> lane
+        # newest membership ccid written to each group's shared peer
+        # books (guards against lagging-member rollback)
+        self._books_ccid: dict[int, int] = {}
         self._members: dict[int, dict[int, KernelNode]] = {}  # sid -> rid -> n
         self._mirrors: dict[int, dict[int, pb.Entry]] = {}    # sid -> mirror
         self._free_lanes = list(range(self.cluster.num_groups - 1, -1, -1))
@@ -171,6 +174,7 @@ class MeshEngine(KernelEngine):
                 lane = self._lane_of.pop(node.shard_id, None)
                 self._members.pop(node.shard_id, None)
                 self._mirrors.pop(node.shard_id, None)
+                self._books_ccid.pop(node.shard_id, None)
                 if lane is not None:
                     self._free_lanes.append(lane)
         return node
@@ -265,26 +269,36 @@ class MeshEngine(KernelEngine):
             # group serves witnesses from the host engines instead
             self._evict(node, reason="witness member on a mesh group")
             return
-        pids = np.zeros((kp.num_peers,), np.int32)
-        kinds = np.zeros((kp.num_peers,), np.int32)
-        i = 0
-        for rid in sorted(m.addresses):
-            pids[i], kinds[i] = rid, KP.K_VOTER
-            i += 1
-        for rid in sorted(m.non_votings):
-            pids[i], kinds[i] = rid, KP.K_NON_VOTING
-            i += 1
-        for rid in sorted(m.witnesses):
-            pids[i], kinds[i] = rid, KP.K_WITNESS
-            i += 1
         s = self.state
-        jp, jk = jax.numpy.asarray(pids), jax.numpy.asarray(kinds)
-        for member in list(self._members.get(node.shard_id, {}).values()):
-            s = s._replace(
-                pid=s.pid.at[member.lane].set(jp),
-                kind=s.kind.at[member.lane].set(jk),
-            )
-            self._kind_np[member.lane] = kinds
+        # the applied CC releases THIS replica's one-in-flight gate only
+        # (pycore clears pending_config_change per replica at apply) — a
+        # lagging follower's apply must not release the leader row's
+        # gate while a newer CC is still uncommitted there
+        s = s._replace(
+            pending_cc=s.pending_cc.at[node.lane].set(False))
+        # shared peer books: members apply the same CCs at different
+        # steps, so only the NEWEST applied membership may write them —
+        # a lagging member's view would roll the group's books back
+        # (config_change_id is monotonic, membership.go ccid)
+        last_ccid = self._books_ccid.get(node.shard_id, -1)
+        if m.config_change_id >= last_ccid:
+            self._books_ccid[node.shard_id] = m.config_change_id
+            pids = np.zeros((kp.num_peers,), np.int32)
+            kinds = np.zeros((kp.num_peers,), np.int32)
+            i = 0
+            for rid in sorted(m.addresses):
+                pids[i], kinds[i] = rid, KP.K_VOTER
+                i += 1
+            for rid in sorted(m.non_votings):
+                pids[i], kinds[i] = rid, KP.K_NON_VOTING
+                i += 1
+            jp, jk = jax.numpy.asarray(pids), jax.numpy.asarray(kinds)
+            for member in list(self._members.get(node.shard_id, {}).values()):
+                s = s._replace(
+                    pid=s.pid.at[member.lane].set(jp),
+                    kind=s.kind.at[member.lane].set(jk),
+                )
+                self._kind_np[member.lane] = kinds
         self.state = s
 
     def _evict(self, n: KernelNode, reason: str, carry=None) -> None:

@@ -216,3 +216,34 @@ def test_kernel_restart_from_disk(tmp_path):
         assert nh.sync_read(1, "dz", timeout_s=10) == "zz"
     finally:
         nh.close()
+
+
+def test_sequential_config_changes_on_kernel_shard():
+    """A lane must accept a SECOND config change after the first applies:
+    the one-in-flight CC gate releases at apply time (pycore add_node/
+    add_non_voting clear pending_config_change; the engine mirrors that
+    by clearing the lane's pending_cc in update_lane_membership).  A
+    regression here limits every device shard to one membership change
+    per lifetime, dropping all later ones."""
+    hosts = make_cluster(f"cc2-{time.monotonic_ns()}")
+    try:
+        from test_nodehost import wait_leader
+
+        lid = wait_leader(hosts, timeout=30)
+        nh = hosts[lid]
+        for rid in (8, 9):   # two back-to-back CCs through the lane
+            deadline = time.time() + 30
+            while True:
+                try:
+                    nh.sync_request_add_nonvoting(
+                        1, rid, f"cc2-nv-{rid}", 0, timeout_s=10)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.2)
+        m = nh.sync_get_shard_membership(1, timeout_s=10)
+        assert 8 in m.non_votings and 9 in m.non_votings
+        assert 1 in nh.kernel_engine.by_shard  # still device-resident
+    finally:
+        close_all(hosts)
