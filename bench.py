@@ -15,7 +15,10 @@ BASELINE.md) — vs_baseline is measured/9e6.
 Phases (one JSON line carries all of them): A headline write throughput
 (uninstrumented), A2 commit-latency percentiles (stamp-ring instrumented
 loop, leader-side release), B 9:1 ReadIndex:write mix (config #3), C
-10k-shard election storm with randomized drops + pre-vote (config #4).
+10k-shard election storm with randomized drops + pre-vote (config #4),
+D membership-change wave + device log compaction under load (config #5:
+every group commits a CC mid-stream; BENCH_CC=0 skips,
+BENCH_CC_ROUNDS sets the wave count).
 
 Env knobs: BENCH_GROUPS (default 8192 on device, 1024 on the CPU
 fallback — one core crunches the batch serially, so scale only slows the
@@ -361,6 +364,62 @@ def _measure(platform: str, groups: int, steps: int) -> None:
             "step_ms": round(dtB / mixed_steps * 1e3, 3),
             "vs_baseline_mixed": round(mixed_ops / 11e6, 4),
         }
+
+        # ---- phase D: membership-change wave + compaction under load
+        # (config #5, kernel rendition): every group commits a config
+        # change mid-stream while the write pipeline and the device ring
+        # compaction keep running; the host clears the one-in-flight
+        # gate after each wave, as the engine's CC apply does ----
+        if os.environ.get("BENCH_CC", "1") == "1":
+            from dragonboat_tpu.bench_loop import cc_step
+
+            cc_rounds = max(1, int(os.environ.get("BENCH_CC_ROUNDS", "3")))
+            cc_period = max(4, chunk)
+            # warm BOTH executables outside the window (iters is a
+            # static jit arg: cc_period-1 is a fresh run_steps variant)
+            state, box, acc0, idx0 = cc_step(kp, replicas, state, box)
+            state, box = run_steps(kp, replicas, cc_period - 1,
+                                   True, True, state, box)
+            state.term.block_until_ready()
+            snap0 = int(np.asarray(state.snap_index)[lead]
+                        .astype(np.int64).sum())
+            cD0 = committed()
+            waves = []
+            tD = time.time()
+            for _ in range(cc_rounds):
+                # gate release: the engine does this when the CC applies
+                state = state._replace(
+                    pending_cc=jnp.zeros_like(state.pending_cc))
+                state, box, acc, idx = cc_step(kp, replicas, state, box)
+                waves.append((acc, idx))
+                state, box = run_steps(kp, replicas, cc_period - 1,
+                                       True, True, state, box)
+            state.committed.block_until_ready()
+            dtD = time.time() - tD
+            writes_d = int(committed() - cD0)
+            committed_now = np.asarray(state.committed)
+            cc_done = cc_acc = 0
+            for acc, idx in waves:
+                # prop_accepted is only ever set on the at-step leader
+                # row — no extra role mask (a stale leadership snapshot
+                # would undercount groups whose leader moved)
+                a = np.asarray(acc)
+                cc_acc += int(a.sum())
+                cc_done += int((a & (committed_now >= np.asarray(idx))).sum())
+            snap1 = int(np.asarray(state.snap_index)[lead]
+                        .astype(np.int64).sum())
+            total_d = cc_rounds * cc_period
+            detail["membership_wave"] = {
+                "rounds": cc_rounds,
+                "cc_accepted": cc_acc,
+                "cc_committed": cc_done,
+                "writes_per_s": round(writes_d / dtD),
+                "step_ms": round(dtD / total_d * 1e3, 3),
+                # throughput under the wave vs the write-only phase A
+                "vs_write_only": round((writes_d / dtD) / max(wps, 1), 3),
+                # device-side log compaction kept running under load
+                "compaction_floor_advance": snap1 - snap0,
+            }
 
         # ---- phase C: 10k-shard election storm (config #4) ----
         if os.environ.get("BENCH_STORM", "1") == "1":

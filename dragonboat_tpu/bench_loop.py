@@ -113,6 +113,23 @@ def full_step(kp: KP.KernelParams, replicas: int, state: ShardState,
     return state, nxt, out
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def cc_step(kp: KP.KernelParams, replicas: int, state: ShardState,
+            box: Inbox):
+    """One step of the membership-change wave (BASELINE config #5): every
+    leader proposes a config-change entry in lane 0 alongside its normal
+    write batch.  The CC rides the ordinary append→replicate→commit
+    pipeline (one-at-a-time gate enforced by the kernel); the bench's
+    host loop plays the engine's role of releasing the gate after the
+    apply (engine update_lane_membership clears pending_cc).  Returns
+    (state, next_box, accepted_cc_mask, cc_index)."""
+    inp = _self_input(kp, state, True, True, None, False, 0)
+    inp = inp._replace(prop_cc=inp.prop_cc.at[:, 0].set(True))
+    state, out = step(kp, state, box, inp)
+    return (state, route(kp, replicas, out),
+            out.prop_accepted[:, 0], out.prop_index[:, 0])
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def run_steps(kp: KP.KernelParams, replicas: int, iters: int,
               tick, propose, state: ShardState, box: Inbox):

@@ -227,8 +227,6 @@ def test_sequential_config_changes_on_kernel_shard():
     per lifetime, dropping all later ones."""
     hosts = make_cluster(f"cc2-{time.monotonic_ns()}")
     try:
-        from test_nodehost import wait_leader
-
         lid = wait_leader(hosts, timeout=30)
         nh = hosts[lid]
         for rid in (8, 9):   # two back-to-back CCs through the lane
