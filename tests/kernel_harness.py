@@ -49,7 +49,8 @@ class KernelCluster:
     def __init__(self, num_groups: int, replicas: int = 3,
                  kp: KP.KernelParams | None = None,
                  election: int = 10, heartbeat: int = 1,
-                 check_quorum: bool = False, pre_vote: bool = False):
+                 check_quorum: bool = False, pre_vote: bool = False,
+                 witnesses: frozenset[int] | set[int] = frozenset()):
         # one shared small geometry across tests → a single kernel compile
         self.kp = kp or KP.KernelParams(
             num_peers=max(3, replicas), log_cap=256, inbox_cap=4,
@@ -57,13 +58,18 @@ class KernelCluster:
         )
         self.n = num_groups
         self.p = replicas
+        self.witnesses = frozenset(witnesses)
         G = num_groups * replicas
         self.G = G
         rids = np.tile(np.arange(1, replicas + 1, dtype=np.int32), num_groups)
         peer_ids = np.zeros((G, self.kp.num_peers), np.int32)
         peer_ids[:, :replicas] = np.arange(1, replicas + 1, dtype=np.int32)
+        peer_kinds = np.where(peer_ids != 0, KP.K_VOTER,
+                              KP.K_ABSENT).astype(np.int32)
+        for rid_w in self.witnesses:
+            peer_kinds[:, rid_w - 1] = KP.K_WITNESS
         self.state: ShardState = init_state(
-            self.kp, G, rids, peer_ids,
+            self.kp, G, rids, peer_ids, peer_kinds=peer_kinds,
             election_timeout=election, heartbeat_timeout=heartbeat,
             check_quorum=check_quorum, pre_vote=pre_vote,
         )

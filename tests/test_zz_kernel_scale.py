@@ -54,6 +54,7 @@ def test_kernel_multi_replica_shards_at_scale():
     from dragonboat_tpu.request import RequestDroppedError, \
         RequestTimeoutError
 
+    from test_kernel_engine import propose_retry
     from test_nodehost import wait_leader
 
     n_shards = 128
@@ -88,16 +89,8 @@ def test_kernel_multi_replica_shards_at_scale():
             lid = wait_leader(hosts, shard_id=sid)
             nh = hosts[lid]
             sess = nh.get_noop_session(sid)
-            end = time.time() + 30
-            while True:
-                try:
-                    nh.sync_propose(sess, f"mr{sid}=ok".encode(),
-                                    timeout_s=10)
-                    break
-                except (RequestDroppedError, RequestTimeoutError):
-                    if time.time() > end:
-                        raise
-                    time.sleep(0.2)
+            propose_retry(nh, sess, f"mr{sid}=ok".encode(),
+                          timeout_s=10, deadline_s=30)
             other = read_from if read_from != lid else (read_from % 3) + 1
             end = time.time() + 30
             while True:
