@@ -439,6 +439,153 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     })
 
 
+def run_serve_bench() -> None:
+    """BENCH_SERVE=1: the SERVING-PATH benchmark — clients propose
+    through the real NodeHost API into device-resident shards across
+    three in-process hosts (chan transport), every write a full raft
+    round ending in one batched fsync.  This is the apples-to-apples
+    shape of the reference's own benchmark (3 servers, client sessions,
+    full stack) — the kernel-only phases above measure the device
+    ceiling; this measures the product.
+
+    Knobs: BENCH_SERVE_SHARDS (default 32), BENCH_SERVE_SECONDS (5),
+    BENCH_SERVE_WINDOW (pipelined proposals per shard, 32)."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _t
+
+    from dragonboat_tpu.client import Session
+    from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.statemachine import IStateMachine, Result
+
+    class NullSM(IStateMachine):
+        """16B-payload sink (the reference benchmark SM records nothing)."""
+
+        def __init__(self, *a):
+            self.n = 0
+
+        def update(self, entry):
+            self.n += 1
+            return Result(value=self.n)
+
+        def lookup(self, q):
+            return self.n
+
+        def save_snapshot(self, w, files, done):
+            w.write(b"\x00")
+
+        def recover_from_snapshot(self, r, files, done):
+            r.read(1)
+
+    n_shards = int(os.environ.get("BENCH_SERVE_SHARDS", "32"))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "5"))
+    window = int(os.environ.get("BENCH_SERVE_WINDOW", "32"))
+    shards = tuple(range(1, n_shards + 1))
+    addrs = {1: "sv-1", 2: "sv-2", 3: "sv-3"}
+    ex = ExpertConfig(kernel_log_cap=128, kernel_capacity=n_shards,
+                      kernel_apply_batch=32, kernel_compaction_overhead=16)
+    hosts = {}
+    # REAL durability: each host gets a tan LogDB on disk so every write
+    # ends in an actual batched fsync (an empty node_host_dir would fall
+    # back to the in-memory LogDB and void the durability claim)
+    root = tempfile.mkdtemp(prefix="dbtpu-serve-")
+    try:
+        for rid, addr in addrs.items():
+            nh = NodeHost(NodeHostConfig(
+                raft_address=addr, rtt_millisecond=2, expert=ex,
+                node_host_dir=os.path.join(root, f"nh{rid}")))
+            hosts[rid] = nh
+            for sid in shards:
+                nh.start_replica(addrs, False, NullSM, Config(
+                    shard_id=sid, replica_id=rid, election_rtt=10,
+                    heartbeat_rtt=2, device_resident=True))
+        deadline = _t.time() + 120
+        elected = 0
+        while _t.time() < deadline:
+            elected = sum(1 for s in shards
+                          if any(hosts[r].get_leader_id(s)[1]
+                                 for r in addrs))
+            if elected == n_shards:
+                break
+            _t.sleep(0.1)
+
+        done = threading.Event()
+        counts = [0] * n_shards
+        lats: list[list[float]] = [[] for _ in range(n_shards)]
+
+        def writer(i: int, sid: int) -> None:
+            # steady pipelined client: the window stays FULL — one new
+            # proposal is issued as each oldest completes (no batch
+            # barrier); the leader host is re-resolved on failures
+            from collections import deque
+
+            payload = b"x" * 16
+            sess = Session.new_noop_session(sid)
+
+            def leader_host():
+                lid, ok = hosts[1].get_leader_id(sid)
+                return hosts[lid if ok and lid in hosts else 1]
+
+            futs: deque = deque()
+            while not done.is_set():
+                try:
+                    nh = leader_host()
+                    while len(futs) < window:
+                        futs.append((nh.propose(sess, payload,
+                                                timeout_s=10.0),
+                                     _t.time()))
+                    f, t0 = futs.popleft()
+                    f.get(10.0)
+                    counts[i] += 1
+                    lats[i].append(_t.time() - t0)
+                except Exception:
+                    futs.clear()   # window poisoned by a leader move
+                    _t.sleep(0.02)
+
+        threads = [threading.Thread(target=writer, args=(i, sid),
+                                    daemon=True)
+                   for i, sid in enumerate(shards)]
+        t_start = _t.time()
+        for t in threads:
+            t.start()
+        _t.sleep(seconds)
+        # snapshot the window BEFORE done/join: the drain tail (writers
+        # blocked in f.get timeouts) must not dilute the steady-state rate
+        wall = _t.time() - t_start
+        total = sum(counts)
+        done.set()
+        for t in threads:
+            t.join(timeout=15)
+        all_lats = sorted(x for li in lats for x in li)
+
+        def pct(q):
+            return (round(all_lats[int(q * (len(all_lats) - 1))] * 1e3, 2)
+                    if all_lats else None)
+
+        emit({
+            "metric": (f"serving-path writes/sec, {n_shards} shards x 3 "
+                       f"replicas, 16B, window {window}"),
+            "value": round(total / wall),
+            "unit": "writes/s",
+            "vs_baseline": round(total / wall / BASELINE_WPS, 4),
+            "detail": {
+                "mode": "serve",
+                "shards": n_shards,
+                "window": window,
+                "seconds": round(wall, 2),
+                "writes": total,
+                "elected": elected,
+                "client_latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+            },
+        })
+    finally:
+        for nh in hosts.values():
+            nh.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_cpu_subprocess(degraded_note: str | None) -> None:
     """Re-exec on CPU and re-emit its JSON line (annotated if degraded)."""
     r = subprocess.run(
@@ -457,6 +604,14 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SERVE") == "1":
+        try:
+            run_serve_bench()
+        except Exception:
+            import traceback
+
+            fail("serve", traceback.format_exc())
+        return
     if os.environ.get("BENCH_IN_CPU_FALLBACK") != "1":
         if os.environ.get("BENCH_FORCE_CPU") == "1":
             run_cpu_subprocess(None)
