@@ -53,6 +53,8 @@ print("RUNG " + json.dumps({{
 
 
 def probe(timeout: float = 60.0) -> bool:
+    if os.environ.get("TPU_GRAB_FORCE_CPU") == "1":
+        return True   # rung self-test: run the ladder on CPU
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -74,10 +76,13 @@ def main() -> None:
     for g in ladder:
         steps = max(20, min(100, 200_000 // g))
         code = RUNG.format(repo=REPO, g=g, steps=steps)
+        env = dict(os.environ)
+        if os.environ.get("TPU_GRAB_FORCE_CPU") == "1":
+            env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
         # generous per-rung timeout: compile at new shapes is slow over
         # the tunnel, but a wedge must not eat the whole session
         try:
-            r = subprocess.run([sys.executable, "-c", code],
+            r = subprocess.run([sys.executable, "-c", code], env=env,
                                capture_output=True, text=True, timeout=900)
         except subprocess.TimeoutExpired:
             rec = {"ts": time.time(), "groups": g, "error": "rung timeout"}
