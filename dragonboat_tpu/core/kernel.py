@@ -820,11 +820,15 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
             s_, eff_, r = _process_family(kp, _fam, s_, eff_, m)
             return (s_, eff_), tuple(r)
 
-        # NOTE: unrolling this scan (lax.scan unroll=) is bitwise-safe
-        # but blows XLA compile time up by an order of magnitude (the
-        # inflated body stalls constant folding) — measured 2026-07-30;
-        # keep it rolled unless TPU profiling shows the loop overhead
-        # dominating AND compile budget allows.
+        # DO NOT unroll this scan.  Measured 2026-07-30 (G=1024): even
+        # unrolling only the small hb/vote families made the step 11x
+        # SLOWER on XLA:CPU (31 -> 345 ms) and tripled compile time.
+        # Rolled, XLA aliases the loop carry and every masked state
+        # update happens in place; unrolled, each slot's straight-line
+        # masked rewrite of the [G, ...] state materializes as a fresh
+        # copy.  The serial launches are the cheaper evil — any future
+        # de-serialization must come from a true vectorized merge that
+        # writes each state array once, not from unrolling.
         (s, eff), part = jax.lax.scan(_scan_msg, (s, eff), sub)
         r_parts.append(part)
     r_stack = tuple(
