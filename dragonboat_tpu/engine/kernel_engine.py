@@ -150,11 +150,12 @@ class KernelNode(Node):
             return
         path = req.path if req.exported else self._snapshot_path(index0)
         self.fs.makedirs(_os.path.dirname(path) or ".")
-        index, term, membership = self.sm.save_snapshot(path)
+        index, term, membership, files = \
+            self.sm.save_snapshot_with_files(path)
         ss = pb.Snapshot(
             filepath=path, file_size=self.fs.getsize(path),
             index=index, term=term, membership=membership,
-            shard_id=self.shard_id, type=self.sm.sm_type,
+            shard_id=self.shard_id, type=self.sm.sm_type, files=files,
         )
         if req.exported:
             from dragonboat_tpu.tools import write_export_metadata

@@ -720,6 +720,16 @@ class Node:
                     _LOG.info("removed orphan snapshot temp %s", fn)
                 except OSError:
                     pass
+            elif ".gbsnap.xf" in fn and (
+                    live_name is None
+                    or not fn.startswith(live_name + ".xf")):
+                # external snapshot files (rsm/files.go) of superseded
+                # snapshots
+                try:
+                    self.fs.remove(full)
+                    _LOG.info("removed superseded snapshot file %s", fn)
+                except OSError:
+                    pass
             elif fn.endswith(".gbsnap") and fn != live_name:
                 try:
                     self.fs.remove(full)
@@ -755,7 +765,8 @@ class Node:
             return
         path = req.path if req.exported else self._snapshot_path(index0)
         self.fs.makedirs(os.path.dirname(path) or ".")
-        index, term, membership = self.sm.save_snapshot(path)
+        index, term, membership, files = \
+            self.sm.save_snapshot_with_files(path)
         ss = pb.Snapshot(
             filepath=path,
             file_size=self.fs.getsize(path),
@@ -764,6 +775,7 @@ class Node:
             membership=membership,
             shard_id=self.shard_id,
             type=self.sm.sm_type,
+            files=files,
             on_disk_index=(index if self.sm.sm_type == pb.StateMachineType.ON_DISK
                            else 0),
         )

@@ -218,6 +218,7 @@ class SnapshotFile:
     file_id: int = 0
     filepath: str = ""
     metadata: bytes = b""
+    file_size: int = 0
 
 
 @dataclass(frozen=True, slots=True)
@@ -502,7 +503,8 @@ def encode_snapshot(s: Snapshot, buf: bytearray) -> None:
     buf += struct.pack("<I", len(s.files))
     for f in s.files:
         fp = f.filepath.encode()
-        buf += struct.pack("<QII", f.file_id, len(fp), len(f.metadata))
+        buf += struct.pack("<QQII", f.file_id, f.file_size, len(fp),
+                           len(f.metadata))
         buf += fp
         buf += f.metadata
 
@@ -525,13 +527,13 @@ def decode_snapshot(data: memoryview, off: int) -> tuple[Snapshot, int]:
     off += 4
     files = []
     for _ in range(nf):
-        fid, fplen, mlen = struct.unpack_from("<QII", data, off)
-        off += 16
+        fid, fsz, fplen, mlen = struct.unpack_from("<QQII", data, off)
+        off += 24
         fpath = bytes(data[off : off + fplen]).decode()
         off += fplen
         meta = bytes(data[off : off + mlen])
         off += mlen
-        files.append(SnapshotFile(fid, fpath, meta))
+        files.append(SnapshotFile(fid, fpath, meta, fsz))
     return (
         Snapshot(
             filepath=path,

@@ -189,39 +189,63 @@ class StateMachine:
         """Under the apply lock: meta + session image + the payload writer
         (ctx captured for concurrent/on-disk SMs so the payload itself can
         be produced OUTSIDE the lock — statemachine.go:553 Prepare under
-        mu, save concurrent)."""
+        mu, save concurrent).  The returned collection receives the user
+        SM's external snapshot files (rsm/files.go)."""
         index, term = self.last_applied, self.last_applied_term
         membership = self.members.get()
         sbuf = io.BytesIO()
         self.sessions.save(sbuf)
         session_data = sbuf.getvalue()
+        fc = _FileCollection()
         if self.sm_type == pb.StateMachineType.REGULAR:
             def write_payload(w):
-                self.sm.save_snapshot(w, _FileCollection(), lambda: False)
+                self.sm.save_snapshot(w, fc, lambda: False)
         elif self.sm_type == pb.StateMachineType.CONCURRENT:
             ctx = self.sm.prepare_snapshot()
 
             def write_payload(w):
-                self.sm.save_snapshot(ctx, w, _FileCollection(),
-                                      lambda: False)
+                self.sm.save_snapshot(ctx, w, fc, lambda: False)
         else:
             ctx = self.sm.prepare_snapshot()
 
             def write_payload(w):
                 self.sm.save_snapshot(ctx, w, lambda: False)
-        return index, term, membership, session_data, write_payload
+        return index, term, membership, session_data, write_payload, fc
 
     def save_snapshot(self, path: str) -> tuple[int, int, pb.Membership]:
+        index, term, membership, _ = self.save_snapshot_with_files(path)
+        return index, term, membership
+
+    def save_snapshot_with_files(self, path: str):
+        """save_snapshot + the external files the user SM attached
+        (ISnapshotFileCollection, rsm/files.go): each is copied next to
+        the snapshot container as ``<path>.xf<file_id>`` and returned as
+        a pb.SnapshotFile tuple for the snapshot record."""
+        from dragonboat_tpu.vfs import copy_file
+
         with self._mu:
-            index, term, membership, session_data, write_payload = \
+            index, term, membership, session_data, write_payload, fc = \
                 self._prepare_save()
             tmp = path + ".generating"
             with self.fs.open(tmp, "wb") as f:
                 write_snapshot(f, session_data, write_payload,
                                compress=self.compress_snapshots)
                 self.fs.fsync(f)
-            self.fs.replace(tmp, path)
-            return index, term, membership
+        # the external-file copies run OUTSIDE the apply lock: fc is
+        # fixed once write_payload returned, snapshot requests are
+        # serialized with this shard's applies (apply-pool lane / step
+        # path), and a multi-GB artifact copy must not stall lookups
+        files = []
+        for sf in fc.files:
+            dst = f"{path}.xf{sf.file_id}"
+            dtmp = dst + ".generating"
+            size = copy_file(self.fs, sf.filepath, dtmp)
+            self.fs.replace(dtmp, dst)
+            files.append(pb.SnapshotFile(
+                file_id=sf.file_id, filepath=dst,
+                metadata=sf.metadata, file_size=size))
+        self.fs.replace(tmp, path)
+        return index, term, membership, tuple(files)
 
     def stream_snapshot(self, w, on_meta=None) -> tuple[int, int, "pb.Membership"]:
         """Streaming save (statemachine.go:568 Stream): write the same
@@ -235,7 +259,10 @@ class StateMachine:
         have no prepared-ctx contract and keep the lock for the write —
         the reference only streams on-disk SMs at all."""
         with self._mu:
-            index, term, membership, session_data, write_payload = \
+            # external files are not carried on the stream path (the
+            # reference only streams on-disk SMs, which have no file
+            # collection API)
+            index, term, membership, session_data, write_payload, _fc = \
                 self._prepare_save()
             if on_meta is not None:
                 on_meta(index, term, membership)
@@ -273,7 +300,15 @@ class StateMachine:
                     if self.sm_type == pb.StateMachineType.ON_DISK:
                         self.sm.recover_from_snapshot(payload, lambda: False)
                     else:
-                        self.sm.recover_from_snapshot(payload, (),
+                        # external files recorded on the snapshot reach
+                        # the user SM with their local paths
+                        # (rsm/files.go; sm recover contract)
+                        ufiles = tuple(
+                            sm_api.SnapshotFile(
+                                file_id=f.file_id, filepath=f.filepath,
+                                metadata=f.metadata)
+                            for f in ss.files)
+                        self.sm.recover_from_snapshot(payload, ufiles,
                                                       lambda: False)
             self.members.set(ss.membership)
             self.last_applied = ss.index
@@ -318,4 +353,9 @@ class _FileCollection:
         self.files: list[sm_api.SnapshotFile] = []
 
     def add_file(self, file_id: int, path: str, metadata: bytes) -> None:
+        # a duplicate id would silently overwrite the copied artifact and
+        # desync the recorded sizes from the shipped byte stream
+        # (files.go AddFile panics on duplicates)
+        if any(f.file_id == file_id for f in self.files):
+            raise ValueError(f"duplicate snapshot file id {file_id}")
         self.files.append(sm_api.SnapshotFile(file_id, path, metadata))

@@ -22,6 +22,7 @@ from dragonboat_tpu.config import NodeHostConfig
 from dragonboat_tpu.logdb.tan import TanLogDB
 from dragonboat_tpu.logger import get_logger
 from dragonboat_tpu.server.env import Env
+from dragonboat_tpu.vfs import copy_file
 
 _LOG = get_logger("tools")
 
@@ -47,6 +48,17 @@ def write_export_metadata(path: str, ss: pb.Snapshot, fs=None) -> None:
             "witnesses": {str(k): v
                           for k, v in ss.membership.witnesses.items()},
         },
+        # external snapshot files (rsm/files.go): recorded by basename —
+        # they travel NEXT TO the exported image
+        "files": [
+            {
+                "file_id": f.file_id,
+                "basename": os.path.basename(f.filepath),
+                "file_size": f.file_size,
+                "metadata_hex": f.metadata.hex(),
+            }
+            for f in ss.files
+        ],
     }
     tmp = path + META_SUFFIX + ".tmp"
     with fs.open(tmp, "w") as f:
@@ -96,10 +108,19 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
             dst_dir,
             f"snapshot-{shard_id:016X}-{replica_id:016X}-{index:016X}"
             ".gbsnap")
-        with fs.open(src_path, "rb") as sf, fs.open(dst, "wb") as df:
-            while chunk := sf.read(1 << 20):
-                df.write(chunk)
-            fs.fsync(df)
+        copy_file(fs, src_path, dst)
+        # external snapshot files travel next to the exported image and
+        # land next to the imported one
+        files = []
+        src_dir = os.path.dirname(src_path) or "."
+        for fm in meta.get("files", ()):
+            src_f = os.path.join(src_dir, fm["basename"])
+            dst_f = f"{dst}.xf{fm['file_id']}"
+            copy_file(fs, src_f, dst_f)
+            files.append(pb.SnapshotFile(
+                file_id=int(fm["file_id"]), filepath=dst_f,
+                metadata=bytes.fromhex(fm.get("metadata_hex", "")),
+                file_size=int(fm["file_size"])))
         ss = pb.Snapshot(
             filepath=dst,
             file_size=fs.getsize(dst),
@@ -109,6 +130,7 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
             shard_id=shard_id,
             type=pb.StateMachineType(meta.get("type", 0)),
             imported=True,
+            files=tuple(files),
         )
         # rebuild the replica's log-db state around the imported snapshot:
         # drop old state, stamp the snapshot + bootstrap (import.go main

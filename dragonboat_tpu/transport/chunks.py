@@ -26,11 +26,49 @@ def split_snapshot_message(m: pb.Message, deployment_id: int,
                            chunk_size: int = SNAPSHOT_CHUNK_SIZE,
                            source_address: str = ""):
     """Yield Chunk records for an InstallSnapshot message
-    (snapshot.go:211 SendSnapshot read-and-split)."""
+    (snapshot.go:211 SendSnapshot read-and-split).
+
+    External snapshot files (rsm/files.go) ride the SAME chunk stream,
+    concatenated after the container in ``ss.files`` order; the receiver
+    splits them back out using the per-file sizes recorded on the
+    snapshot (ChunkSink._split_external_files)."""
     ss = m.snapshot
-    file_size = os.path.getsize(ss.filepath) if ss.filepath else 0
+    main_size = os.path.getsize(ss.filepath) if ss.filepath else 0
+    file_size = main_size + sum(f.file_size for f in ss.files)
     count = max(1, (file_size + chunk_size - 1) // chunk_size)
-    with open(ss.filepath, "rb") if ss.filepath else _null_file() as f:
+
+    def byte_stream():
+        paths = ([ss.filepath] if ss.filepath else []) + [
+            f.filepath for f in ss.files]
+        for p in paths:
+            with open(p, "rb") as f:
+                while True:
+                    block = f.read(chunk_size)
+                    if not block:
+                        break
+                    yield block
+
+    class _concat:
+        def __init__(self):
+            self.gen = byte_stream()
+            self.buf = b""
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self, n):
+            while len(self.buf) < n:
+                block = next(self.gen, None)
+                if block is None:
+                    break
+                self.buf += block
+            out, self.buf = self.buf[:n], self.buf[n:]
+            return out
+
+    with (_concat() if file_size else _null_file()) as f:
         for cid in range(count):
             data = f.read(chunk_size)
             yield pb.Chunk(
@@ -137,10 +175,42 @@ class ChunkSink:
             # nodehost message path and must not serialize other transfers
             m = completed.message
             from dataclasses import replace
+            files = self._split_external_files(completed.path,
+                                               m.snapshot.files)
             m = replace(m, snapshot=replace(m.snapshot,
-                                            filepath=completed.path))
+                                            filepath=completed.path,
+                                            files=files))
             self.deliver(m, completed.source_address)
         return True
+
+    @staticmethod
+    def _split_external_files(path: str, files):
+        """The sender concatenated external snapshot files after the
+        container (split_snapshot_message); carve them back out next to
+        the reassembled file and truncate the container to its own bytes
+        (chunk.go multi-file reassembly, compressed into one stream)."""
+        if not files:
+            return files
+        from dataclasses import replace
+        total = os.path.getsize(path)
+        main_size = total - sum(f.file_size for f in files)
+        out = []
+        with open(path, "rb") as f:
+            f.seek(main_size)
+            for sf in files:
+                dst = f"{path}.xf{sf.file_id}"
+                remaining = sf.file_size
+                with open(dst, "wb") as o:
+                    while remaining:
+                        block = f.read(min(remaining, 1 << 20))
+                        if not block:
+                            break
+                        o.write(block)
+                        remaining -= len(block)
+                out.append(replace(sf, filepath=dst))
+        with open(path, "r+b") as f:
+            f.truncate(main_size)
+        return tuple(out)
 
     def _abort_locked(self, key) -> None:
         t = self.transfers.pop(key, None)

@@ -22,7 +22,8 @@ import io
 import os
 import threading
 
-__all__ = ["OSFS", "MemFS", "ErrorFS", "InjectedError", "default_fs"]
+__all__ = ["OSFS", "MemFS", "ErrorFS", "InjectedError", "default_fs",
+           "copy_file"]
 
 
 class InjectedError(OSError):
@@ -378,3 +379,20 @@ class ErrorFS:
     def flock_unlock(self, f) -> None:
         inner = f._f if isinstance(f, _ErrFile) else f
         self.base.flock_unlock(inner)
+
+
+def copy_file(fs, src: str, dst: str, block: int = 1 << 20) -> int:
+    """Copy src -> dst through ``fs`` with a trailing fsync; returns the
+    byte count.  The one file-copy loop (snapshot containers, external
+    snapshot files, import staging) so block size and fsync discipline
+    cannot drift between call sites."""
+    n = 0
+    with fs.open(src, "rb") as f, fs.open(dst, "wb") as out:
+        while True:
+            chunk = f.read(block)
+            if not chunk:
+                break
+            out.write(chunk)
+            n += len(chunk)
+        fs.fsync(out)
+    return n

@@ -1,0 +1,182 @@
+"""External snapshot files (rsm/files.go + ISnapshotFileCollection):
+user SMs attach extra files at save time; they are recorded on the
+snapshot, shipped through the chunk stream to installing peers, handed
+back at recover time, GC'd with their snapshot, and carried through
+export/import."""
+
+import json
+import os
+import struct
+import time
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.rsm.statemachine import StateMachine
+from dragonboat_tpu.statemachine import IStateMachine, Result
+
+from test_nodehost import wait_leader
+
+
+class FileKV(IStateMachine):
+    """KV whose snapshot stores the dict in an EXTERNAL file; the main
+    payload holds only a marker (like the reference's example of large
+    side artifacts shipped as snapshot files)."""
+
+    def __init__(self, shard_id=0, replica_id=0):
+        self.kv = {}
+        self.recovered_files = None
+        self._scratch = f"/tmp/filekv-{os.getpid()}-{id(self)}.json"
+
+    def update(self, entry):
+        k, v = entry.cmd.decode().split("=", 1)
+        self.kv[k] = v
+        return Result(value=len(self.kv))
+
+    def lookup(self, q):
+        return self.kv.get(q)
+
+    def save_snapshot(self, w, files, done):
+        with open(self._scratch, "w") as f:
+            json.dump(self.kv, f)
+        files.add_file(1, self._scratch, b"kv-image")
+        w.write(struct.pack("<I", 0xF11E))
+
+    def recover_from_snapshot(self, r, files, done):
+        (marker,) = struct.unpack("<I", r.read(4))
+        assert marker == 0xF11E
+        self.recovered_files = list(files)
+        main = next(f for f in files if f.file_id == 1)
+        assert main.metadata == b"kv-image"
+        with open(main.filepath) as f:
+            self.kv = json.load(f)
+
+
+def test_files_roundtrip_local(tmp_path):
+    sm = StateMachine(1, 1, FileKV())
+    for i in range(5):
+        sm.handle([pb.Entry(term=1, index=i + 1, cmd=f"k{i}=v{i}".encode())])
+    path = str(tmp_path / "snap.gbsnap")
+    index, term, membership, files = sm.save_snapshot_with_files(path)
+    assert len(files) == 1 and files[0].file_id == 1
+    assert files[0].filepath == path + ".xf1"
+    assert files[0].file_size == os.path.getsize(path + ".xf1")
+
+    sm2 = StateMachine(1, 1, FileKV())
+    ss = pb.Snapshot(index=index, term=term, membership=membership,
+                     filepath=path, files=files)
+    sm2.recover_from_snapshot(path, ss)
+    assert sm2.lookup("k4") == "v4"
+    assert sm2.sm.recovered_files is not None
+
+
+def test_files_ship_through_chunked_install():
+    """A lagging replica recovers the external file via the chunk
+    stream (sender concatenates, receiver splits)."""
+    addrs = {i: f"sf-{time.monotonic_ns()}-{i}" for i in (1, 2, 3)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=5))
+        nh.start_replica(addrs, False, FileKV, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[rid] = nh
+    try:
+        lid = wait_leader(hosts)
+        lag = next(r for r in hosts if r != lid)
+        hosts[lag].close()
+        del hosts[lag]
+        s = hosts[lid].get_noop_session(1)
+        for i in range(30):
+            hosts[lid].sync_propose(s, f"d{i}=v{i}".encode(), timeout_s=10)
+        nh2 = NodeHost(NodeHostConfig(raft_address=addrs[lag],
+                                      rtt_millisecond=5))
+        nh2.start_replica(addrs, False, FileKV, Config(
+            shard_id=1, replica_id=lag, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[lag] = nh2
+        deadline = time.time() + 20
+        while time.time() < deadline and nh2.stale_read(1, "d29") != "v29":
+            time.sleep(0.05)
+        assert nh2.stale_read(1, "d29") == "v29", \
+            "lagger never caught up via the file-carrying snapshot"
+        node = nh2._node(1)
+        assert node.sm.sm.recovered_files, \
+            "external file never reached the installing SM"
+        got = node.sm.sm.recovered_files[0]
+        assert got.metadata == b"kv-image" and os.path.exists(got.filepath)
+    finally:
+        for nh in hosts.values():
+            nh.close()
+
+
+def test_files_gc_with_superseded_snapshots(tmp_path):
+    """Startup GC removes .xf companions of superseded snapshots and
+    keeps the live one's."""
+    addr = f"sfgc-{time.monotonic_ns()}"
+    nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=2))
+    try:
+        nh.start_replica({1: addr}, False, FileKV, Config(
+            shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=4, compaction_overhead=1))
+        deadline = time.time() + 10
+        while time.time() < deadline and not nh.get_leader_id(1)[1]:
+            time.sleep(0.02)
+        s = nh.get_noop_session(1)
+        for i in range(20):   # several snapshot generations
+            nh.sync_propose(s, f"g{i}=v{i}".encode(), timeout_s=10)
+        node = nh._node(1)
+        snapdir = node.snapshot_dir
+        live = nh.logdb.get_snapshot(1, 1)
+        assert live is not None and live.files
+        # restart-time GC: a fresh Node in the same dir prunes orphans
+        node._gc_snapshot_dir(live)
+        xfs = [fn for fn in os.listdir(snapdir) if ".gbsnap.xf" in fn]
+        live_base = os.path.basename(live.filepath)
+        assert xfs == [f"{live_base}.xf1"], xfs
+    finally:
+        nh.close()
+
+
+def test_files_survive_export_import(tmp_path):
+    """sync_request_snapshot(export) carries the external file; tools
+    import places it next to the imported image and the restarted
+    single-member shard recovers through it."""
+    from dragonboat_tpu import tools
+
+    root = str(tmp_path / "nh")
+    addr = f"sfx-{time.monotonic_ns()}"
+    nh = NodeHost(NodeHostConfig(raft_address=addr, node_host_dir=root,
+                                 rtt_millisecond=2))
+    export_dir = tmp_path / "export"
+    export_dir.mkdir()
+    export_path = str(export_dir / "exported.gbsnap")
+    try:
+        nh.start_replica({1: addr}, False, FileKV, Config(
+            shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+        deadline = time.time() + 10
+        while time.time() < deadline and not nh.get_leader_id(1)[1]:
+            time.sleep(0.02)
+        s = nh.get_noop_session(1)
+        for i in range(8):
+            nh.sync_propose(s, f"e{i}=v{i}".encode(), timeout_s=10)
+        nh.sync_request_snapshot(1, export_path=export_path, timeout_s=10)
+        assert os.path.exists(export_path + ".xf1")
+    finally:
+        nh.close()
+
+    tools.import_snapshot(
+        NodeHostConfig(raft_address=addr, node_host_dir=root),
+        export_path, {1: addr}, 1)
+    nh2 = NodeHost(NodeHostConfig(raft_address=addr, node_host_dir=root,
+                                  rtt_millisecond=2))
+    try:
+        nh2.start_replica({1: addr}, False, FileKV, Config(
+            shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1))
+        deadline = time.time() + 10
+        while time.time() < deadline and nh2.stale_read(1, "e7") != "v7":
+            time.sleep(0.05)
+        assert nh2.stale_read(1, "e7") == "v7"
+        assert nh2._node(1).sm.sm.recovered_files
+    finally:
+        nh2.close()
