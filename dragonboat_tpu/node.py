@@ -710,9 +710,12 @@ class Node:
         live_name = (os.path.basename(live.filepath)
                      if live is not None and live.filepath else None)
         prefix = f"snapshot-{self.shard_id:016X}-{self.replica_id:016X}-"
+        # installed snapshots land as incoming-* (transport/chunks.py)
+        # and must be swept once superseded, like local ones
+        in_prefix = f"incoming-{self.shard_id:016X}-{self.replica_id:016X}-"
         for fn in self.fs.listdir(self.snapshot_dir):
             full = os.path.join(self.snapshot_dir, fn)
-            if not fn.startswith(prefix):
+            if not (fn.startswith(prefix) or fn.startswith(in_prefix)):
                 continue  # another shard's files (shared non-env dir)
             if fn.endswith(".generating"):
                 try:

@@ -180,3 +180,41 @@ def test_files_survive_export_import(tmp_path):
         assert nh2._node(1).sm.sm.recovered_files
     finally:
         nh2.close()
+
+
+def test_gc_sweeps_superseded_installed_snapshots():
+    """Installed snapshots land as incoming-*; once superseded by a
+    newer local snapshot they must be swept like snapshot-* files (they
+    previously lingered forever)."""
+    addr = f"sfin-{time.monotonic_ns()}"
+    nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=2))
+    try:
+        nh.start_replica({1: addr}, False, FileKV, Config(
+            shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=4, compaction_overhead=1))
+        deadline = time.time() + 10
+        while time.time() < deadline and not nh.get_leader_id(1)[1]:
+            time.sleep(0.02)
+        s = nh.get_noop_session(1)
+        for i in range(10):
+            nh.sync_propose(s, f"q{i}=v{i}".encode(), timeout_s=10)
+        node = nh._node(1)
+        snapdir = node.snapshot_dir
+        # plant a stale installed snapshot + companion for THIS replica
+        # and a foreign shard's file that must survive
+        stale = os.path.join(
+            snapdir, f"incoming-{1:016X}-{1:016X}-{3:016X}.gbsnap")
+        open(stale, "wb").write(b"stale")
+        open(stale + ".xf1", "wb").write(b"stale-xf")
+        foreign = os.path.join(
+            snapdir, f"incoming-{2:016X}-{9:016X}-{3:016X}.gbsnap")
+        open(foreign, "wb").write(b"other-shard")
+        live = nh.logdb.get_snapshot(1, 1)
+        node._gc_snapshot_dir(live)
+        names = set(os.listdir(snapdir))
+        assert os.path.basename(stale) not in names
+        assert os.path.basename(stale) + ".xf1" not in names
+        assert os.path.basename(foreign) in names
+        assert os.path.basename(live.filepath) in names
+    finally:
+        nh.close()
