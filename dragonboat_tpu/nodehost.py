@@ -836,6 +836,37 @@ class NodeHost:
     def read_local_node(self, shard_id: int, query: object) -> object:
         return self._node(shard_id).sm.lookup(query)
 
+    def na_read_local_node(self, shard_id: int, query: object) -> object:
+        """NAReadLocalNode (nodehost.go:877): the no-copy byte-slice
+        variant — Python has no owned/borrowed distinction, so this is
+        read_local_node under the reference's name."""
+        return self.read_local_node(shard_id, query)
+
+    def get_log_reader(self, shard_id: int):
+        """GetLogReader (nodehost.go:617): the shard's read-only log
+        reader (first/last index, term lookups, entry ranges)."""
+        return self._node(shard_id).log_reader
+
+    def get_node_host_registry(self):
+        """GetNodeHostRegistry (nodehost.go:463): (registry, ok) — ok
+        only when gossip addressing is active (the registry then carries
+        other hosts' metadata)."""
+        from dragonboat_tpu.gossip import GossipRegistry
+
+        return self.registry, isinstance(self.registry, GossipRegistry)
+
+    @property
+    def raft_address(self) -> str:
+        """RaftAddress (nodehost.go:447)."""
+        return self.config.raft_address
+
+    def get_node_user(self, shard_id: int) -> "NodeUser":
+        """GetNodeUser (nodehost.go:1324): a per-shard handle bundling
+        propose/read_index for one shard (INodeUser API shape; calls
+        resolve the shard live so eviction/stop is always respected)."""
+        self._node(shard_id)  # raises ShardNotFoundError when absent
+        return NodeUser(self, shard_id)
+
     def sync_read(self, shard_id: int, query: object,
                   timeout_s: float = DEFAULT_TIMEOUT_S) -> object:
         rs = self.read_index(shard_id, timeout_s)
@@ -852,15 +883,9 @@ class NodeHost:
         self, shard_id: int, cc_type: pb.ConfigChangeType, replica_id: int,
         target: str, config_change_index: int, timeout_s: float,
     ) -> None:
-        node = self._node(shard_id)
-        cc = pb.ConfigChange(
-            config_change_id=config_change_index,
-            type=cc_type,
-            replica_id=replica_id,
-            address=target,
-        )
-        rs = node.request_config_change(cc, self._ticks(timeout_s))
-        self._work.set()
+        rs = self._request_config_change(
+            shard_id, cc_type, replica_id, target, config_change_index,
+            timeout_s)
         rs.get(timeout_s)
 
     def sync_request_add_replica(self, shard_id: int, replica_id: int,
@@ -901,6 +926,90 @@ class NodeHost:
     def get_shard_membership(self, shard_id: int) -> pb.Membership:
         return self._node(shard_id).sm.get_membership()
 
+    # -- async request variants (nodehost.go:963-1238: the Request*
+    # family returns the future; the Sync* family above waits on it) ----
+
+    def request_snapshot(self, shard_id: int,
+                         timeout_s: float = DEFAULT_TIMEOUT_S,
+                         export_path: str = "",
+                         compaction_overhead: int | None = None
+                         ) -> RequestState:
+        """RequestSnapshot (nodehost.go:963) — the async variant."""
+        node = self._node(shard_id)
+        req = _SnapshotRequest(
+            exported=bool(export_path),
+            path=export_path,
+            override_compaction=compaction_overhead is not None,
+            compaction_overhead=compaction_overhead or 0,
+        )
+        rs = node.request_snapshot(req, self._ticks(timeout_s))
+        self._work.set()
+        return rs
+
+    def request_compaction(self, shard_id: int,
+                           timeout_s: float = DEFAULT_TIMEOUT_S
+                           ) -> RequestState:
+        """RequestCompaction (nodehost.go:993) — the async variant."""
+        rs = self._node(shard_id).request_compaction(self._ticks(timeout_s))
+        self._work.set()
+        return rs
+
+    def _request_config_change(
+        self, shard_id: int, cc_type: pb.ConfigChangeType, replica_id: int,
+        target: str, config_change_index: int, timeout_s: float,
+    ) -> RequestState:
+        node = self._node(shard_id)
+        cc = pb.ConfigChange(
+            config_change_id=config_change_index,
+            type=cc_type, replica_id=replica_id, address=target,
+        )
+        rs = node.request_config_change(cc, self._ticks(timeout_s))
+        self._work.set()
+        return rs
+
+    def request_add_replica(self, shard_id: int, replica_id: int,
+                            target: str, config_change_index: int = 0,
+                            timeout_s: float = DEFAULT_TIMEOUT_S
+                            ) -> RequestState:
+        return self._request_config_change(
+            shard_id, pb.ConfigChangeType.ADD_NODE, replica_id, target,
+            config_change_index, timeout_s)
+
+    def request_add_nonvoting(self, shard_id: int, replica_id: int,
+                              target: str, config_change_index: int = 0,
+                              timeout_s: float = DEFAULT_TIMEOUT_S
+                              ) -> RequestState:
+        return self._request_config_change(
+            shard_id, pb.ConfigChangeType.ADD_NON_VOTING, replica_id,
+            target, config_change_index, timeout_s)
+
+    def request_add_witness(self, shard_id: int, replica_id: int,
+                            target: str, config_change_index: int = 0,
+                            timeout_s: float = DEFAULT_TIMEOUT_S
+                            ) -> RequestState:
+        return self._request_config_change(
+            shard_id, pb.ConfigChangeType.ADD_WITNESS, replica_id, target,
+            config_change_index, timeout_s)
+
+    def request_delete_replica(self, shard_id: int, replica_id: int,
+                               config_change_index: int = 0,
+                               timeout_s: float = DEFAULT_TIMEOUT_S
+                               ) -> RequestState:
+        return self._request_config_change(
+            shard_id, pb.ConfigChangeType.REMOVE_NODE, replica_id, "",
+            config_change_index, timeout_s)
+
+    def propose_session(self, session: Session,
+                        timeout_s: float = DEFAULT_TIMEOUT_S
+                        ) -> RequestState:
+        """ProposeSession (nodehost.go:816): propose the session's
+        current lifecycle op (the caller prepared it for register or
+        unregister) and return the future."""
+        node = self._node(session.shard_id)
+        rs = node.propose_session_op(session, self._ticks(timeout_s))
+        self._work.set()
+        return rs
+
     # -- leadership ------------------------------------------------------
 
     def request_leader_transfer(self, shard_id: int, target: int) -> None:
@@ -919,15 +1028,9 @@ class NodeHost:
                               timeout_s: float = DEFAULT_TIMEOUT_S,
                               export_path: str = "",
                               compaction_overhead: int | None = None) -> int:
-        node = self._node(shard_id)
-        req = _SnapshotRequest(
-            exported=bool(export_path),
-            path=export_path,
-            override_compaction=compaction_overhead is not None,
-            compaction_overhead=compaction_overhead or 0,
-        )
-        rs = node.request_snapshot(req, self._ticks(timeout_s))
-        self._work.set()
+        rs = self.request_snapshot(shard_id, timeout_s,
+                                   export_path=export_path,
+                                   compaction_overhead=compaction_overhead)
         r = rs.wait(timeout_s)
         if r.code != RequestResultCode.COMPLETED:
             raise RequestError(f"snapshot failed: {r.code.name}")
@@ -938,9 +1041,7 @@ class NodeHost:
         """SyncRequestCompaction: LogDB compaction up to the snapshotter's
         compacted-to index, processed on the engine thread
         (nodehost.go RequestCompaction → node.go:972)."""
-        node = self._node(shard_id)
-        rs = node.request_compaction(self._ticks(timeout_s))
-        self._work.set()
+        rs = self.request_compaction(shard_id, timeout_s)
         r = rs.wait(timeout_s)
         if r.code == RequestResultCode.REJECTED:
             raise RequestRejectedError(
@@ -948,13 +1049,18 @@ class NodeHost:
         if r.code != RequestResultCode.COMPLETED:
             raise RequestError(f"compaction failed: {r.code.name}")
 
-    def sync_remove_data(self, shard_id: int, replica_id: int,
-                         timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
-        """RemoveData (nodehost.go:1295): purge a stopped replica's state."""
+    def remove_data(self, shard_id: int, replica_id: int) -> None:
+        """RemoveData (nodehost.go:1295): purge a stopped replica's
+        state; raises while the shard is still running."""
         with self.mu:
             if shard_id in self.nodes:
                 raise RequestError("shard still running")
         self.logdb.remove_node_data(shard_id, replica_id)
+
+    def sync_remove_data(self, shard_id: int, replica_id: int,
+                         timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+        """SyncRemoveData (nodehost.go:1259)."""
+        self.remove_data(shard_id, replica_id)
 
     # -- log queries -----------------------------------------------------
 
@@ -1054,3 +1160,25 @@ class NodeHost:
             raise RequestError(
                 "state machine does not implement get_hash()")
         return int(get_hash())
+
+
+class NodeUser:
+    """Per-shard client handle (nodehost.go:1324 GetNodeUser /
+    INodeUser): Propose and ReadIndex bound to one shard; the futures
+    are the same RequestStates the NodeHost API returns."""
+
+    __slots__ = ("_nh", "shard_id")
+
+    def __init__(self, nh: NodeHost, shard_id: int) -> None:
+        self._nh = nh
+        self.shard_id = shard_id
+
+    def propose(self, session: Session, cmd: bytes,
+                timeout_s: float = DEFAULT_TIMEOUT_S) -> RequestState:
+        if session.shard_id != self.shard_id:
+            raise RequestError("session targets a different shard")
+        return self._nh.propose(session, cmd, timeout_s)
+
+    def read_index(self, timeout_s: float = DEFAULT_TIMEOUT_S
+                   ) -> RequestState:
+        return self._nh.read_index(self.shard_id, timeout_s)

@@ -1,0 +1,97 @@
+"""Async request variants + small public-surface parity
+(nodehost.go:963-1359: Request*/ProposeSession/GetLogReader/
+GetNodeUser/NAReadLocalNode/RemoveData/registry accessor)."""
+
+import time
+
+from dragonboat_tpu.client import Session
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.request import RequestError, RequestResultCode
+
+from test_nodehost import KVStateMachine
+
+
+def _host():
+    addr = f"api-{time.monotonic_ns()}"
+    nh = NodeHost(NodeHostConfig(raft_address=addr, rtt_millisecond=2))
+    nh.start_replica({1: addr}, False, KVStateMachine, Config(
+        shard_id=1, replica_id=1, election_rtt=10, heartbeat_rtt=1,
+        snapshot_entries=0, compaction_overhead=2))
+    deadline = time.time() + 10
+    while time.time() < deadline and not nh.get_leader_id(1)[1]:
+        time.sleep(0.02)
+    return nh
+
+
+def test_async_request_variants_complete():
+    nh = _host()
+    try:
+        s = nh.get_noop_session(1)
+        nh.sync_propose(s, b"a=1", timeout_s=5)
+        # async membership change
+        rs = nh.request_add_nonvoting(1, 7, "else:1", 0, timeout_s=5)
+        rs.get(5)
+        assert 7 in nh.get_shard_membership(1).non_votings
+        rs = nh.request_delete_replica(1, 7, 0, timeout_s=5)
+        rs.get(5)
+        assert 7 not in nh.get_shard_membership(1).non_votings
+        # async snapshot + compaction
+        rs = nh.request_snapshot(1, timeout_s=5)
+        r = rs.wait(5)
+        assert r.code == RequestResultCode.COMPLETED
+        assert r.snapshot_index >= 3
+        rs = nh.request_compaction(1, timeout_s=5)
+        r = rs.wait(5)
+        assert r.code == RequestResultCode.COMPLETED
+    finally:
+        nh.close()
+
+
+def test_propose_session_async_lifecycle():
+    nh = _host()
+    try:
+        s = Session.new_session(1)
+        s.prepare_for_register()
+        nh.propose_session(s, timeout_s=5).get(5)
+        s.prepare_for_propose()
+        r = nh.sync_propose(s, b"k=v", timeout_s=5)  # advances the series
+        assert r.value == 1
+        s.prepare_for_unregister()
+        nh.propose_session(s, timeout_s=5).get(5)
+    finally:
+        nh.close()
+
+
+def test_node_user_and_small_surface():
+    nh = _host()
+    try:
+        nu = nh.get_node_user(1)
+        s = nh.get_noop_session(1)
+        nu.propose(s, b"x=y", timeout_s=5).get(5)
+        nu.read_index(timeout_s=5).get(5)
+        assert nh.na_read_local_node(1, "x") == "y"
+        lr = nh.get_log_reader(1)
+        assert lr.last_index() >= 1
+        assert nh.raft_address.startswith("api-")
+        reg, via_gossip = nh.get_node_host_registry()
+        assert reg is not None and via_gossip is False
+    finally:
+        nh.close()
+
+
+def test_remove_data_requires_stopped_shard(tmp_path):
+    nh = _host()
+    try:
+        s = nh.get_noop_session(1)
+        nh.sync_propose(s, b"a=1", timeout_s=5)
+        try:
+            nh.remove_data(1, 1)
+            raise AssertionError("remove_data on a RUNNING shard passed")
+        except RequestError:
+            pass
+        nh.stop_replica(1)
+        nh.remove_data(1, 1)
+        assert not nh.has_node_info(1, 1)
+    finally:
+        nh.close()
