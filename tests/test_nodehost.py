@@ -142,7 +142,10 @@ def test_membership_add_and_remove(cluster):
     assert sorted(m.addresses) == [1, 2, 3]
     # add a 4th replica
     addr4 = list(cluster.values())[0].config.raft_address.rsplit("-", 1)[0] + "-4"
-    nh.sync_request_add_replica(1, 4, addr4, m.config_change_id)
+    # generous timeout: this test runs late in the suite on a 1-core CI
+    # box where neighbors can starve the engine past the 5 s default
+    nh.sync_request_add_replica(1, 4, addr4, m.config_change_id,
+                                timeout_s=20.0)
     nh4 = NodeHost(NodeHostConfig(raft_address=addr4, rtt_millisecond=5,
                                   ))
     try:
@@ -159,7 +162,8 @@ def test_membership_add_and_remove(cluster):
         m = nh.sync_get_shard_membership(1)
         assert sorted(m.addresses) == [1, 2, 3, 4]
         # remove it again
-        nh.sync_request_delete_replica(1, 4, m.config_change_id)
+        nh.sync_request_delete_replica(1, 4, m.config_change_id,
+                                       timeout_s=20.0)
         m = nh.sync_get_shard_membership(1)
         assert sorted(m.addresses) == [1, 2, 3]
         assert 4 in m.removed
