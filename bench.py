@@ -295,6 +295,20 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     wps = writes / dt
     step_ms = dt / steps * 1e3
 
+    # provisional record: if a slow-tunnel run is killed externally in a
+    # later phase, the LAST stdout line is still a valid measurement of
+    # the headline instead of nothing (the complete line below
+    # supersedes it on a full run)
+    emit({
+        "metric": (f"replicated writes/sec, {groups} groups x 3 replicas, "
+                   f"16B (provisional: phase A only)"),
+        "value": round(wps),
+        "unit": "writes/s",
+        "vs_baseline": round(wps / BASELINE_WPS, 4),
+        "detail": {"platform": platform, "groups": groups,
+                   "provisional": "later phases may still be running"},
+    })
+
     detail = {
         "platform": platform,
         "groups": groups,
