@@ -72,6 +72,15 @@ class EngineConfig:
     close_shards: int = 32
 
 
+@dataclass
+class LogDBConfig:
+    """Expert log-engine geometry (config/config.go:780,845): the durable
+    log is split into ``shards`` single-writer partitions so concurrent
+    step workers flush different files (internal/logdb/sharded.go:34)."""
+
+    shards: int = 16
+
+
 @dataclass(frozen=True)
 class MeshSpec:
     """Placement of device-resident shards onto a multi-chip mesh.
@@ -95,6 +104,7 @@ class MeshSpec:
 @dataclass
 class ExpertConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
+    logdb: LogDBConfig = field(default_factory=LogDBConfig)
     # multi-chip placement for mesh_resident shards (None = single-device
     # kernel engine only)
     mesh: MeshSpec | None = None

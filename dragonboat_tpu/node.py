@@ -59,6 +59,7 @@ class Node:
         rng=None,
         events: EventHub | None = None,
         fs=None,
+        worker_id: int = 0,
     ) -> None:
         from dragonboat_tpu.vfs import default_fs
 
@@ -67,6 +68,10 @@ class Node:
         self.shard_id = cfg.shard_id
         self.replica_id = cfg.replica_id
         self.logdb = logdb
+        # the step worker that owns this node (engine.go:1107 workerPool);
+        # passed to save_raft_state per the single-writer-per-worker
+        # contract (raftio/logdb.go:78-83)
+        self.worker_id = worker_id
         self.sm = sm
         self.send_message = send_message
         self.snapshot_dir = snapshot_dir
@@ -483,7 +488,7 @@ class Node:
             if m.type == pb.MessageType.REPLICATE:
                 self._send(m)
         # THE fsync
-        self.logdb.save_raft_state([ud], worker_id=0)
+        self.logdb.save_raft_state([ud], worker_id=self.worker_id)
         if ud.entries_to_save:
             self.log_reader.append(ud.entries_to_save)
         if not ud.snapshot.is_empty():

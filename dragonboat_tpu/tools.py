@@ -135,7 +135,16 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
         # rebuild the replica's log-db state around the imported snapshot:
         # drop old state, stamp the snapshot + bootstrap (import.go main
         # flow: ssEnv.FinalizeSnapshot + logdb writes)
-        db = TanLogDB(env.logdb_dir, fs=fs)
+        # open the dir's own engine: the geometry the owning NodeHost
+        # pinned (TANSHARDS marker), or the default sharded layout for a
+        # fresh/legacy dir — a flat TanLogDB here would strand the
+        # R_REMOVE + import records outside the partitions
+        from dragonboat_tpu.logdb.sharded import ShardedLogDB
+
+        stored = ShardedLogDB.stored_shard_count(env.logdb_dir, fs)
+        db = ShardedLogDB(env.logdb_dir,
+                          num_shards=stored if stored is not None else 16,
+                          fs=fs)
         try:
             db.import_snapshot(ss, replica_id)
         finally:

@@ -44,7 +44,9 @@ def test_tan_is_default_with_node_host_dir(tmp_path):
     nh = NodeHost(NodeHostConfig(raft_address="t-1", rtt_millisecond=5,
                                  node_host_dir=str(tmp_path)))
     try:
-        assert nh.logdb.name() == "tan"
+        # the default engine is tan, sharded into single-writer
+        # partitions (logdb/sharded.py; internal/logdb/sharded.go:34)
+        assert nh.logdb.name().startswith("sharded-tan")
         assert nh.env is not None
         assert os.path.exists(os.path.join(nh.env.root, "LOCK"))
         assert os.path.exists(os.path.join(nh.env.root, "dragonboat.ds"))
@@ -231,7 +233,8 @@ def test_wal_dir_separates_log_volume(tmp_path):
         nh.sync_propose(sess, f"wl{i}=v{i}".encode())
     logdb_dir = nh.env.logdb_dir
     assert str(tmp_path / "wal") in logdb_dir
-    assert any(f.endswith(".tan") for f in os.listdir(logdb_dir))
+    assert any(f.endswith(".tan")
+               for _, _, files in os.walk(logdb_dir) for f in files)
     # a second host sharing ONLY the WAL volume is excluded
     with pytest.raises(DirLockedError):
         NodeHost(NodeHostConfig(raft_address="wd-1", rtt_millisecond=5,

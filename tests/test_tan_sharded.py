@@ -1,0 +1,240 @@
+"""Sharded tan engine: partition routing, restart recovery across
+partitions, overlapping fsyncs (the single-lock bug the r3 VERDICT
+flagged), geometry pinning, legacy-layout migration, and spanning-batch
+saves from the device engine's [G]-batch shape.
+
+Parity target: internal/logdb/sharded.go:34-80 (ShardedDB over N
+single-writer DBs), internal/server/partition.go:59 (DoubleFixed
+partitioner), raftio/logdb.go:78-83 (single-writer-per-worker fsync
+contract)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.logdb.sharded import (
+    ShardedLogDB,
+    ShardGeometryError,
+)
+from dragonboat_tpu.logdb.tan import TanLogDB
+
+
+def _update(shard=1, replica=1, term=1, first=1, n=3, commit=0):
+    ents = tuple(
+        pb.Entry(term=term, index=first + i, cmd=f"s{shard}e{first + i}".encode())
+        for i in range(n)
+    )
+    return pb.Update(
+        shard_id=shard, replica_id=replica,
+        state=pb.State(term=term, vote=2, commit=commit),
+        entries_to_save=ents,
+    )
+
+
+def test_routing_spreads_partitions(tmp_path):
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    for sid in range(1, 9):
+        db.save_raft_state([_update(shard=sid, n=2)], worker_id=sid % 4)
+    # every shard readable through the facade
+    for sid in range(1, 9):
+        ents = db.iterate_entries(sid, 1, 1, 3, 0)
+        assert [e.index for e in ents] == [1, 2]
+        assert ents[0].cmd == f"s{sid}e1".encode()
+    # and the files really are spread over >1 partition dir
+    parts_with_data = [
+        d for d in os.listdir(tmp_path)
+        if d.startswith("part-")
+        and any(f.startswith("log-") and os.path.getsize(
+            os.path.join(tmp_path, d, f)) > 0
+            for f in os.listdir(os.path.join(tmp_path, d)))
+    ]
+    assert len(parts_with_data) == 4
+    db.close()
+
+
+def test_restart_across_partitions(tmp_path):
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    db.save_bootstrap_info(3, 1, pb.Bootstrap(addresses={1: "a"}))
+    for sid in (1, 2, 3, 6, 7):
+        db.save_raft_state([_update(shard=sid, n=4, commit=2)], worker_id=0)
+    db.close()
+
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4)
+    infos = {(ni.shard_id, ni.replica_id) for ni in db2.list_node_info()}
+    assert infos == {(1, 1), (2, 1), (3, 1), (6, 1), (7, 1)}
+    for sid in (1, 2, 3, 6, 7):
+        rs = db2.read_raft_state(sid, 1, 0)
+        assert rs.entry_count == 4 and rs.state.commit == 2
+    assert db2.get_bootstrap_info(3, 1).addresses == {1: "a"}
+    db2.close()
+
+
+def test_geometry_change_refused(tmp_path):
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    db.save_raft_state([_update()], worker_id=0)
+    db.close()
+    with pytest.raises(ShardGeometryError):
+        ShardedLogDB(str(tmp_path), num_shards=8)
+    with pytest.raises(ShardGeometryError):
+        ShardedLogDB(str(tmp_path), num_shards=2)
+    # the original geometry still opens
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4)
+    assert db2.read_raft_state(1, 1, 0) is not None
+    db2.close()
+
+
+def test_legacy_flat_layout_migrates(tmp_path):
+    old = TanLogDB(str(tmp_path))
+    old.save_bootstrap_info(1, 1, pb.Bootstrap(addresses={1: "x", 2: "y"}))
+    for sid in (1, 2, 5):
+        old.save_raft_state([_update(shard=sid, n=3, commit=1)], worker_id=0)
+    old.save_snapshots([pb.Update(
+        shard_id=2, replica_id=1,
+        snapshot=pb.Snapshot(index=1, term=1, shard_id=2),
+    )])
+    old.close()
+    assert any(f.startswith("log-") for f in os.listdir(tmp_path))
+
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    # flat files folded into partitions and removed from the root
+    assert not any(f.startswith("log-") for f in os.listdir(tmp_path))
+    for sid in (1, 5):
+        ents = db.iterate_entries(sid, 1, 1, 4, 0)
+        assert [e.index for e in ents] == [1, 2, 3]
+    # shard 2 had a snapshot at index 1: migration keeps the live suffix
+    # (snapshot.index+1 ..), exactly what restart-from-disk reads
+    assert [e.index for e in db.iterate_entries(2, 1, 2, 4, 0)] == [2, 3]
+    assert db.get_bootstrap_info(1, 1).addresses == {1: "x", 2: "y"}
+    ss = db.get_snapshot(2, 1)
+    assert ss is not None and ss.index == 1
+    db.close()
+
+    # and the migrated layout survives another restart
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4)
+    assert [e.index for e in db2.iterate_entries(5, 1, 1, 4, 0)] == [1, 2, 3]
+    db2.close()
+
+
+def test_spanning_batch_save_and_snapshot_routing(tmp_path):
+    """The device engine saves one [G]-lane batch covering many
+    partitions in ONE call (engine/kernel_engine.py step loop)."""
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    batch = [_update(shard=sid, n=2, commit=1) for sid in range(1, 33)]
+    db.save_raft_state(batch, worker_id=0)
+    for sid in range(1, 33):
+        assert db.read_raft_state(sid, 1, 0).entry_count == 2
+    db.save_snapshots([pb.Update(
+        shard_id=sid, replica_id=1,
+        snapshot=pb.Snapshot(index=2, term=1, shard_id=sid))
+        for sid in range(1, 33)])
+    db.close()
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4)
+    for sid in range(1, 33):
+        assert db2.get_snapshot(sid, 1).index == 2
+    db2.close()
+
+
+def test_remove_and_compact_route(tmp_path):
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    for sid in (1, 2):
+        db.save_raft_state([_update(shard=sid, n=6, commit=5)], worker_id=0)
+    db.remove_entries_to(1, 1, 3)
+    assert [e.index for e in db.iterate_entries(1, 1, 4, 7, 0)] == [4, 5, 6]
+    assert db.iterate_entries(1, 1, 1, 7, 0) == []   # below the floor
+    db.remove_node_data(2, 1)
+    assert db.read_raft_state(2, 1, 0) is None
+    infos = {ni.shard_id for ni in db.list_node_info()}
+    assert infos == {1}
+    db.close()
+
+
+class _SlowFsyncFS:
+    """OSFS wrapper whose fsync sleeps — makes overlap measurable."""
+
+    def __init__(self, delay):
+        from dragonboat_tpu.vfs import OSFS
+
+        self._fs = OSFS()
+        self.delay = delay
+        self.fsyncs = 0
+        self._mu = threading.Lock()
+
+    def __getattr__(self, name):
+        return getattr(self._fs, name)
+
+    def fsync(self, f):
+        with self._mu:
+            self.fsyncs += 1
+        time.sleep(self.delay)
+        self._fs.fsync(f)
+
+
+def test_fsyncs_overlap_across_partitions(tmp_path):
+    """THE r3 VERDICT finding: with the single-file engine, W workers
+    serialized on one lock+file. Two workers flushing different
+    partitions must overlap their fsyncs (wall << 2 x serial)."""
+    delay = 0.15
+    fs = _SlowFsyncFS(delay)
+    db = ShardedLogDB(str(tmp_path), num_shards=4, fs=fs)
+    n_each = 4
+
+    def worker(sid, wid):
+        for i in range(n_each):
+            db.save_raft_state(
+                [_update(shard=sid, first=1 + 2 * i, n=2)], worker_id=wid)
+
+    t0 = time.time()
+    ts = [threading.Thread(target=worker, args=(sid, sid % 4))
+          for sid in (1, 2, 3, 4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.time() - t0
+    serial = 4 * n_each * delay     # what the single-lock engine would cost
+    # four truly-concurrent streams should land near n_each * delay;
+    # allow generous scheduler slack on the 1-core CI box
+    assert wall < serial * 0.6, (wall, serial)
+    db.close()
+
+
+def test_crash_kill_recovery_all_partitions(tmp_path):
+    """Kill the process image (skip close) after spanning writes; every
+    partition must recover, including a torn tail in each partition."""
+    db = ShardedLogDB(str(tmp_path), num_shards=4)
+    for sid in range(1, 9):
+        db.save_raft_state([_update(shard=sid, n=3, commit=2)], worker_id=0)
+    # simulate the crash: no close(), then garble a torn tail onto every
+    # partition's active file (an unsynced partial record)
+    for i in range(4):
+        pdir = os.path.join(tmp_path, f"part-{i:02d}")
+        logs = sorted(f for f in os.listdir(pdir) if f.startswith("log-"))
+        with open(os.path.join(pdir, logs[-1]), "ab") as f:
+            f.write(b"\x02\x00NE\x7f")     # half a header
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4)
+    for sid in range(1, 9):
+        assert [e.index for e in db2.iterate_entries(sid, 1, 1, 4, 0)] == \
+            [1, 2, 3]
+    # and the recovered engine accepts new writes
+    db2.save_raft_state([_update(shard=1, first=4, n=1)], worker_id=0)
+    assert db2.read_raft_state(1, 1, 0).entry_count == 4
+    db2.close()
+
+
+def test_nodehost_default_is_sharded(tmp_path):
+    from dragonboat_tpu.config import NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+
+    nh = NodeHost(NodeHostConfig(
+        node_host_dir=str(tmp_path / "nh"),
+        raft_address="localhost:26000",
+    ), auto_run=False)
+    try:
+        assert nh.logdb.name().startswith("sharded-tan")
+        assert os.path.isdir(os.path.join(nh.env.logdb_dir and
+                                          nh.env.logdb_dir, "part-00"))
+    finally:
+        nh.close()
