@@ -121,6 +121,9 @@ class KernelNode(Node):
     def leader_id(self) -> int:
         return self._leader_cache
 
+    def node_term(self) -> int:
+        return self._leader_term_cache
+
     def is_leader(self) -> bool:
         return self._leader_cache == self.replica_id
 

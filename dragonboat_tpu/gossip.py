@@ -13,6 +13,14 @@ version, dead-member expiry).
 to a target string as usual; a target that is a NodeHostID is then
 translated through the gossip view (gossip.go:157 Resolve →
 metaStore.get).
+
+Beyond addresses, hosts exchange a cluster-wide **shard view**
+(``internal/registry/view.go:36-149``): per shard
+``{shard_id, replicas, config_change_index, leader_id, term}``, merged
+by config-change index (membership) and leader term (leadership), so any
+host can answer "who leads shard N" without hosting a replica of it.
+``GossipRegistry.get_shard_info`` / ``num_of_shards`` mirror
+NodeHostRegistry (``internal/registry/nodehost.go:23-41``).
 """
 
 from __future__ import annotations
@@ -43,16 +51,50 @@ class _Meta:
         self.seen_at = time.monotonic()
 
 
+class ShardView:
+    """One shard as the gossip mesh knows it (view.go:68-74)."""
+
+    __slots__ = ("shard_id", "replicas", "config_change_index",
+                 "leader_id", "term")
+
+    def __init__(self, shard_id: int, replicas: dict[int, str] | None = None,
+                 config_change_index: int = 0, leader_id: int = 0,
+                 term: int = 0) -> None:
+        self.shard_id = shard_id
+        self.replicas = replicas or {}
+        self.config_change_index = config_change_index
+        self.leader_id = leader_id
+        self.term = term
+
+
+def _merge_shard_view(cur: ShardView, upd: ShardView) -> ShardView:
+    """view.go:121 mergeShardView: membership by config-change index,
+    leadership by (known leader, higher term)."""
+    if cur.config_change_index < upd.config_change_index:
+        cur.replicas = upd.replicas
+        cur.config_change_index = upd.config_change_index
+    if upd.leader_id != 0 and (cur.leader_id == 0 or upd.term > cur.term):
+        cur.leader_id = upd.leader_id
+        cur.term = upd.term
+    return cur
+
+
 class GossipManager:
     """UDP anti-entropy: each round, push the full view to up to FANOUT
     known members (+ the seeds until they answer)."""
 
     def __init__(self, nhid: str, raft_address: str, bind_address: str,
                  advertise_address: str = "", seeds: list[str] | None = None,
-                 interval_s: float = GOSSIP_INTERVAL_S) -> None:
+                 interval_s: float = GOSSIP_INTERVAL_S,
+                 shard_info_fn=None) -> None:
         self.nhid = nhid
         self.raft_address = raft_address
         self.interval_s = interval_s
+        # () -> list[ShardView] of the LOCAL host's shards, refreshed
+        # before every push (nodehost wires get_node_host_info here)
+        self.shard_info_fn = shard_info_fn
+        self.shards: dict[int, ShardView] = {}
+        self._last_refresh = 0.0
         host, port = _parse(bind_address)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((host, port))
@@ -77,14 +119,67 @@ class GossipManager:
 
     # -- protocol ---------------------------------------------------------
 
-    def _payload(self) -> bytes:
+    # a UDP datagram caps at ~65507 bytes; stay well under so the view
+    # plus one shard chunk always fits (big shard sets span datagrams,
+    # the anti-entropy merge is idempotent so chunk loss only delays)
+    _MAX_DATAGRAM = 48 << 10
+
+    def _payloads(self) -> list[bytes]:
+        """The view datagram plus as many shard-chunk datagrams as the
+        size cap requires (memberlist chunks its broadcasts the same
+        way — one oversized sendto would EMSGSIZE and silently kill
+        ALL dissemination)."""
+        self._refresh_local_shards()
         with self.mu:
             view = {n: [m.raft_address, m.version]
                     for n, m in self.view.items()}
-        return json.dumps({
-            "from": self.advertise,
-            "view": view,
-        }).encode()
+            shards = [[v.shard_id,
+                       {str(r): a for r, a in v.replicas.items()},
+                       v.config_change_index, v.leader_id, v.term]
+                      for v in self.shards.values()]
+        head = {"from": self.advertise, "view": view}
+        out = []
+        base = json.dumps(head).encode()
+        room = self._MAX_DATAGRAM - len(base) - len(',"shards":[]')
+        chunk: list = []
+        used = 0
+        for rec in shards:
+            blob = json.dumps(rec)
+            if chunk and used + len(blob) > room:
+                out.append(json.dumps({**head, "shards": chunk}).encode())
+                # subsequent datagrams repeat only the (small) header
+                head = {"from": self.advertise}
+                room = self._MAX_DATAGRAM - len(json.dumps(head)) - 16
+                chunk, used = [], 0
+            chunk.append(rec)
+            used += len(blob) + 1
+        out.append(json.dumps({**head, "shards": chunk}).encode())
+        return out
+
+    def _refresh_local_shards(self, min_interval_s: float | None = None
+                              ) -> None:
+        """Fold the local host's current shard states into the merged
+        store (the reference's delegate pulls getShardInfo the same way
+        before each exchange, gossip.go LocalState)."""
+        if self.shard_info_fn is None:
+            return
+        now = time.monotonic()
+        if min_interval_s is not None and \
+                now - self._last_refresh < min_interval_s:
+            return
+        self._last_refresh = now
+        try:
+            local = self.shard_info_fn()
+        except Exception:
+            _LOG.debug("shard_info_fn failed", exc_info=True)
+            return
+        with self.mu:
+            for v in local:
+                cur = self.shards.get(v.shard_id)
+                if cur is None:
+                    self.shards[v.shard_id] = v
+                else:
+                    self.shards[v.shard_id] = _merge_shard_view(cur, v)
 
     def _run(self) -> None:
         last_push = 0.0
@@ -107,17 +202,18 @@ class GossipManager:
                            addr, exc_info=True)
 
     def _push(self) -> None:
-        payload = self._payload()
+        payloads = self._payloads()
         with self.mu:
             known = list(self.members)
         targets = set(self.seeds)
         if known:
             targets.update(random.sample(known, min(FANOUT, len(known))))
         for t in targets:
-            try:
-                self.sock.sendto(payload, _parse(t))
-            except (OSError, ValueError):
-                pass
+            for payload in payloads:
+                try:
+                    self.sock.sendto(payload, _parse(t))
+                except (OSError, ValueError):
+                    break   # unreachable peer: skip its remaining chunks
 
     def _merge(self, msg: dict) -> None:
         src = msg.get("from")
@@ -132,6 +228,23 @@ class GossipManager:
                     self.members[src] = now
                 except ValueError:
                     pass
+            shards = msg.get("shards")
+            if isinstance(shards, list):
+                for rec in shards:
+                    try:
+                        sid = int(rec[0])
+                        upd = ShardView(
+                            sid,
+                            {int(r): str(a) for r, a in rec[1].items()},
+                            int(rec[2]), int(rec[3]), int(rec[4]))
+                    except (TypeError, ValueError, IndexError,
+                            AttributeError):
+                        continue
+                    cur = self.shards.get(sid)
+                    if cur is None:
+                        self.shards[sid] = upd
+                    else:
+                        self.shards[sid] = _merge_shard_view(cur, upd)
             for nhid, rec in view.items():
                 if nhid == self.nhid:
                     # the local record is authoritative here — a stale
@@ -163,6 +276,23 @@ class GossipManager:
     def num_members(self) -> int:
         with self.mu:
             return len(self.members) + 1
+
+    def get_shard_info(self, shard_id: int) -> ShardView | None:
+        # queries mostly ride the store the push loop maintains; the
+        # rate-limited refresh just bounds staleness for hosts that are
+        # pure pollers (no shards of their own changing)
+        self._refresh_local_shards(min_interval_s=self.interval_s)
+        with self.mu:
+            v = self.shards.get(shard_id)
+            if v is None:
+                return None
+            return ShardView(v.shard_id, dict(v.replicas),
+                             v.config_change_index, v.leader_id, v.term)
+
+    def num_of_shards(self) -> int:
+        self._refresh_local_shards(min_interval_s=self.interval_s)
+        with self.mu:
+            return len(self.shards)
 
     def set_raft_address(self, raft_address: str) -> None:
         """Re-advertise after an address change (the reason this whole
@@ -208,6 +338,17 @@ class GossipRegistry(INodeRegistry):
                     f"NodeHostID {target} not (yet) known to gossip")
             return addr, key
         return target, key
+
+    # -- NodeHostRegistry surface (internal/registry/nodehost.go:23-41) --
+
+    def num_of_shards(self) -> int:
+        """Number of shards known to the gossip mesh (not just local)."""
+        return self.manager.num_of_shards()
+
+    def get_shard_info(self, shard_id: int) -> ShardView | None:
+        """Cluster-wide view of one shard: membership at the highest
+        config-change index seen, leadership at the highest term."""
+        return self.manager.get_shard_info(shard_id)
 
     def close(self) -> None:
         self.manager.close()

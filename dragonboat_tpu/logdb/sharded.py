@@ -242,11 +242,12 @@ class ShardedLogDBFactory:
     """config.LogDBFactory equivalent producing the sharded engine."""
 
     def __init__(self, root_dir: str, num_shards: int = 16,
-                 max_file_size: int = 64 << 20) -> None:
+                 max_file_size: int = 64 << 20, fs=None) -> None:
         self.root_dir = root_dir
         self.num_shards = num_shards
         self.max_file_size = max_file_size
+        self.fs = fs
 
     def create(self) -> ShardedLogDB:
         return ShardedLogDB(self.root_dir, self.num_shards,
-                            self.max_file_size)
+                            self.max_file_size, fs=self.fs)

@@ -872,5 +872,8 @@ class Node:
     def leader_id(self) -> int:
         return self.peer.raft.leader_id if self.peer else 0
 
+    def node_term(self) -> int:
+        return self.peer.raft.term if self.peer else self._last_leader[1]
+
     def is_leader(self) -> bool:
         return bool(self.peer and self.peer.raft.is_leader())

@@ -57,6 +57,7 @@ class ShardInfo:
     shard_id: int
     replica_id: int
     leader_id: int
+    term: int
     is_leader: bool
     membership: pb.Membership
     last_applied: int
@@ -102,11 +103,12 @@ class NodeHost:
                     self.env.check_node_host_dir(self.logdb.name())
                 else:
                     # validate the dir BEFORE tan touches the wal root so a
-                    # refused reopen leaves no stray log files behind
-                    # (the flag string stays "tan" across the sharded
-                    # layout change — partitioning is a directory shape,
-                    # not an engine change, and old dirs migrate in place)
-                    self.env.check_node_host_dir("tan")
+                    # refused reopen leaves no stray log files behind;
+                    # legacy flat-"tan" dirs migrate in place and get the
+                    # flag bumped so a rolled-back binary refuses them
+                    # instead of seeing an empty log
+                    self.env.check_node_host_dir("sharded-tan",
+                                                 compatible=("tan",))
                     self.logdb = ShardedLogDB(
                         self.env.logdb_dir,
                         num_shards=nhconfig.expert.logdb.shards,
@@ -134,6 +136,7 @@ class NodeHost:
                 nhconfig.gossip.bind_address,
                 nhconfig.gossip.advertise_address,
                 list(nhconfig.gossip.seed),
+                shard_info_fn=self._local_shard_views,
             ))
         else:
             self.registry = Registry()
@@ -1093,6 +1096,26 @@ class NodeHost:
 
     # -- info ------------------------------------------------------------
 
+    def _local_shard_views(self):
+        """This host's shards as ShardViews for the gossip exchange
+        (view.go:77 toShardViewList): replica addresses come from the
+        replicated membership, leadership from the live node."""
+        from dragonboat_tpu.gossip import ShardView
+
+        with self.mu:
+            nodes = list(self.nodes.values())
+        out = []
+        for n in nodes:
+            mb = n.sm.get_membership()
+            out.append(ShardView(
+                shard_id=n.shard_id,
+                replicas=dict(mb.addresses),
+                config_change_index=mb.config_change_id,
+                leader_id=n.leader_id(),
+                term=n.node_term(),
+            ))
+        return out
+
     def get_node_host_info(self) -> NodeHostInfo:
         with self.mu:
             nodes = list(self.nodes.values())
@@ -1101,6 +1124,7 @@ class NodeHost:
                 shard_id=n.shard_id,
                 replica_id=n.replica_id,
                 leader_id=n.leader_id(),
+                term=n.node_term(),
                 is_leader=n.is_leader(),
                 membership=n.sm.get_membership(),
                 last_applied=n.sm.get_last_applied(),

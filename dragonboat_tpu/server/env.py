@@ -149,12 +149,18 @@ class Env:
 
     # -- flag file (dragonboat.ds) -----------------------------------------
 
-    def check_node_host_dir(self, logdb_type: str) -> None:
+    def check_node_host_dir(self, logdb_type: str,
+                            compatible: tuple[str, ...] = ()) -> None:
         """check (:390): create or validate the data-status flag file, in
         the root AND on the WAL volume (checkNodeHostDir validates both
         data dirs).  The root flag records whether a separate WAL dir was
         in use, so reopening with a changed wal_dir is refused instead of
-        silently starting from an empty raft log."""
+        silently starting from an empty raft log.
+
+        ``compatible`` lists legacy logdb_type strings this engine can
+        open by in-place migration; a matching legacy flag is rewritten
+        to the current type so an OLD binary cannot later open the
+        migrated dir and silently see an empty log."""
         status = {
             "address": self.raft_address,
             "hostname": self.hostname,
@@ -164,11 +170,12 @@ class Env:
             "hard_hash": hard.hash(),
             "wal": self.wal_root if self.wal_root != self.root else "",
         }
-        self._check_dir(self.root, status)
+        self._check_dir(self.root, status, compatible)
         if self.wal_root != self.root:
-            self._check_dir(self.wal_root, status)
+            self._check_dir(self.wal_root, status, compatible)
 
-    def _check_dir(self, d: str, status: dict) -> None:
+    def _check_dir(self, d: str, status: dict,
+                   compatible: tuple[str, ...] = ()) -> None:
         fp = os.path.join(d, FLAG_FILENAME)
         if not self.fs.exists(fp):
             tmp = fp + ".tmp"
@@ -179,6 +186,17 @@ class Env:
             return
         with self.fs.open(fp, "r") as f:
             saved = json.loads(f.read())
+        if saved.get("logdb_type") in compatible:
+            # legacy engine this one migrates in place: stamp the new
+            # type (atomic replace) before any data is touched
+            rewritten = dict(saved)
+            rewritten["logdb_type"] = status["logdb_type"]
+            tmp = fp + ".tmp"
+            with self.fs.open(tmp, "w") as f:
+                json.dump(rewritten, f)
+                self.fs.fsync(f)
+            self.fs.replace(tmp, fp)
+            saved = rewritten
         if saved.get("address", "").strip().lower() != \
                 self.raft_address.strip().lower():
             raise NotOwnerError(

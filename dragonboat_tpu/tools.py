@@ -99,7 +99,7 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
               nhconfig.deployment_id, wal_dir=nhconfig.wal_dir, fs=fs)
     env.lock()
     try:
-        env.check_node_host_dir("tan")
+        env.check_node_host_dir("sharded-tan", compatible=("tan",))
         shard_id = int(meta["shard_id"])
         # place the image in the replica's snapshot dir
         dst_dir = env.snapshot_dir(shard_id, replica_id)
@@ -142,9 +142,11 @@ def import_snapshot(nhconfig: NodeHostConfig, src_path: str,
         from dragonboat_tpu.logdb.sharded import ShardedLogDB
 
         stored = ShardedLogDB.stored_shard_count(env.logdb_dir, fs)
-        db = ShardedLogDB(env.logdb_dir,
-                          num_shards=stored if stored is not None else 16,
-                          fs=fs)
+        db = ShardedLogDB(
+            env.logdb_dir,
+            num_shards=(stored if stored is not None
+                        else nhconfig.expert.logdb.shards),
+            fs=fs)
         try:
             db.import_snapshot(ss, replica_id)
         finally:

@@ -238,3 +238,37 @@ def test_nodehost_default_is_sharded(tmp_path):
                                           nh.env.logdb_dir, "part-00"))
     finally:
         nh.close()
+
+
+def test_legacy_dir_flag_bumped_on_migration(tmp_path):
+    """A flat-'tan' NodeHost dir migrates AND gets its flag rewritten to
+    sharded-tan, so a rolled-back pre-sharding binary refuses the dir
+    instead of silently starting from an empty log."""
+    import json
+
+    from dragonboat_tpu.config import NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.server.env import FLAG_FILENAME
+
+    nh = NodeHost(NodeHostConfig(node_host_dir=str(tmp_path),
+                                 raft_address="flag-1"), auto_run=False)
+    nh.close()
+    fp = None
+    for dirpath, _, files in os.walk(tmp_path):
+        if FLAG_FILENAME in files:
+            fp = os.path.join(dirpath, FLAG_FILENAME)
+            break
+    assert fp is not None
+    with open(fp) as f:
+        assert json.load(f)["logdb_type"] == "sharded-tan"
+    # simulate a legacy dir: rewrite the flag back to "tan"
+    with open(fp) as f:
+        saved = json.load(f)
+    saved["logdb_type"] = "tan"
+    with open(fp, "w") as f:
+        json.dump(saved, f)
+    nh2 = NodeHost(NodeHostConfig(node_host_dir=str(tmp_path),
+                                  raft_address="flag-1"), auto_run=False)
+    nh2.close()
+    with open(fp) as f:
+        assert json.load(f)["logdb_type"] == "sharded-tan"
