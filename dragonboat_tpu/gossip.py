@@ -97,6 +97,14 @@ class GossipManager:
         self._last_refresh = 0.0
         host, port = _parse(bind_address)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # multi-datagram bursts (chunked big views) overflow the
+            # default rcvbuf; losing the SAME tail chunks every round
+            # would stall anti-entropy convergence
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 4 << 20)
+        except OSError:
+            pass
         self.sock.bind((host, port))
         self.sock.settimeout(0.05)
         bound = self.sock.getsockname()
@@ -125,35 +133,57 @@ class GossipManager:
     _MAX_DATAGRAM = 48 << 10
 
     def _payloads(self) -> list[bytes]:
-        """The view datagram plus as many shard-chunk datagrams as the
-        size cap requires (memberlist chunks its broadcasts the same
-        way — one oversized sendto would EMSGSIZE and silently kill
-        ALL dissemination)."""
+        """Pack the member view AND the shard views into as many
+        size-capped datagrams as needed (memberlist chunks its
+        broadcasts the same way — one oversized sendto would EMSGSIZE
+        and silently kill ALL dissemination).  Both record kinds are
+        merged idempotently on receive, so any record landing in any
+        datagram is enough."""
         self._refresh_local_shards()
         with self.mu:
-            view = {n: [m.raft_address, m.version]
-                    for n, m in self.view.items()}
-            shards = [[v.shard_id,
-                       {str(r): a for r, a in v.replicas.items()},
-                       v.config_change_index, v.leader_id, v.term]
-                      for v in self.shards.values()]
-        head = {"from": self.advertise, "view": view}
-        out = []
-        base = json.dumps(head).encode()
-        room = self._MAX_DATAGRAM - len(base) - len(',"shards":[]')
-        chunk: list = []
+            view_recs = [(n, [m.raft_address, m.version])
+                         for n, m in self.view.items()]
+            shard_recs = [[v.shard_id,
+                           {str(r): a for r, a in v.replicas.items()},
+                           v.config_change_index, v.leader_id, v.term]
+                          for v in self.shards.values()]
+        # the local address record rides every datagram so any single
+        # received chunk identifies + locates the sender
+        self_view = {n: rec for n, rec in view_recs if n == self.nhid}
+        overhead = len(json.dumps({
+            "from": self.advertise, "view": self_view, "shards": [],
+        })) + 2
+        room = self._MAX_DATAGRAM - overhead
+        out: list[bytes] = []
+        view_chunk: dict = dict(self_view)
+        shard_chunk: list = []
         used = 0
-        for rec in shards:
-            blob = json.dumps(rec)
-            if chunk and used + len(blob) > room:
-                out.append(json.dumps({**head, "shards": chunk}).encode())
-                # subsequent datagrams repeat only the (small) header
-                head = {"from": self.advertise}
-                room = self._MAX_DATAGRAM - len(json.dumps(head)) - 16
-                chunk, used = [], 0
-            chunk.append(rec)
-            used += len(blob) + 1
-        out.append(json.dumps({**head, "shards": chunk}).encode())
+
+        def flush():
+            nonlocal view_chunk, shard_chunk, used
+            out.append(json.dumps({
+                "from": self.advertise,
+                "view": view_chunk,
+                "shards": shard_chunk,
+            }).encode())
+            view_chunk, shard_chunk, used = dict(self_view), [], 0
+
+        items = [("v", r) for r in view_recs if r[0] != self.nhid] \
+            + [("s", r) for r in shard_recs]
+        # randomize chunk membership per push: if a fixed-size prefix of
+        # the burst is all a congested receiver keeps, a deterministic
+        # order would starve the same records forever
+        random.shuffle(items)
+        for kind, rec in items:
+            cost = len(json.dumps(rec)) + 8
+            if used and used + cost > room:
+                flush()
+            if kind == "v":
+                view_chunk[rec[0]] = rec[1]
+            else:
+                shard_chunk.append(rec)
+            used += cost
+        flush()
         return out
 
     def _refresh_local_shards(self, min_interval_s: float | None = None
@@ -213,7 +243,9 @@ class GossipManager:
                 try:
                     self.sock.sendto(payload, _parse(t))
                 except (OSError, ValueError):
-                    break   # unreachable peer: skip its remaining chunks
+                    # skip only this datagram: a payload-specific error
+                    # (e.g. EMSGSIZE) must not starve the other chunks
+                    continue
 
     def _merge(self, msg: dict) -> None:
         src = msg.get("from")

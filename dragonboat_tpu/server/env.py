@@ -170,33 +170,42 @@ class Env:
             "hard_hash": hard.hash(),
             "wal": self.wal_root if self.wal_root != self.root else "",
         }
-        self._check_dir(self.root, status, compatible)
+        dirs = [self.root]
         if self.wal_root != self.root:
-            self._check_dir(self.wal_root, status, compatible)
+            dirs.append(self.wal_root)
+        # validate EVERY dir before rewriting ANY legacy flag: a refused
+        # open (wrong owner/hostname/hard-hash/...) must leave the dir
+        # untouched for its rightful binary
+        rewrite = [self._check_dir(d, status, compatible) for d in dirs]
+        for d, legacy in zip(dirs, rewrite):
+            if legacy:
+                fp = os.path.join(d, FLAG_FILENAME)
+                with self.fs.open(fp, "r") as f:
+                    saved = json.loads(f.read())
+                saved["logdb_type"] = status["logdb_type"]
+                self._write_flag(fp, saved)
+
+    def _write_flag(self, fp: str, status: dict) -> None:
+        tmp = fp + ".tmp"
+        with self.fs.open(tmp, "w") as f:
+            json.dump(status, f)
+            self.fs.fsync(f)
+        self.fs.replace(tmp, fp)
 
     def _check_dir(self, d: str, status: dict,
-                   compatible: tuple[str, ...] = ()) -> None:
+                   compatible: tuple[str, ...] = ()) -> bool:
+        """Returns True when the dir carries a legacy-compatible flag the
+        caller should rewrite AFTER all dirs validate."""
         fp = os.path.join(d, FLAG_FILENAME)
         if not self.fs.exists(fp):
-            tmp = fp + ".tmp"
-            with self.fs.open(tmp, "w") as f:
-                json.dump(status, f)
-                self.fs.fsync(f)
-            self.fs.replace(tmp, fp)
-            return
+            self._write_flag(fp, status)
+            return False
         with self.fs.open(fp, "r") as f:
             saved = json.loads(f.read())
-        if saved.get("logdb_type") in compatible:
-            # legacy engine this one migrates in place: stamp the new
-            # type (atomic replace) before any data is touched
-            rewritten = dict(saved)
-            rewritten["logdb_type"] = status["logdb_type"]
-            tmp = fp + ".tmp"
-            with self.fs.open(tmp, "w") as f:
-                json.dump(rewritten, f)
-                self.fs.fsync(f)
-            self.fs.replace(tmp, fp)
-            saved = rewritten
+        legacy = saved.get("logdb_type") in compatible
+        if legacy:
+            saved = dict(saved)
+            saved["logdb_type"] = status["logdb_type"]
         if saved.get("address", "").strip().lower() != \
                 self.raft_address.strip().lower():
             raise NotOwnerError(
@@ -226,6 +235,7 @@ class Env:
                 f"WALDir changed: {saved.get('wal') or '<none>'} -> "
                 f"{status['wal'] or '<none>'} — the raft log would be "
                 f"left behind")
+        return legacy
 
     # -- identity ----------------------------------------------------------
 

@@ -1,0 +1,279 @@
+"""Go-wire interop codec (raftpb/gowire.py) — three layers of evidence:
+
+1. **Hand-traced golden fixtures**: exact byte strings traced from the
+   reference's generated marshal code (file:line cited per fixture).
+   The build image has no Go toolchain, so these are the closest thing
+   to reference-emitted bytes available; each was written by following
+   the cited marshaler statement by statement.
+2. **protobuf cross-oracle**: a reconstructed raft.proto compiled with
+   protoc; python-protobuf must parse gowire's bytes to the same field
+   values, and gowire must decode python-protobuf's serialization.
+   This independently checks every tag number and wire type (Colfer
+   entries excluded — protobuf can't speak Colfer).
+3. **Round-trips** over randomized values, including the >= 2**49
+   fixed64 Colfer arm and truncation robustness.
+"""
+
+import random
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.raftpb import gowire as gw
+
+
+# --------------------------------------------------------------------------
+# 1. golden fixtures
+# --------------------------------------------------------------------------
+
+
+def test_golden_state():
+    # state.go:27-41: tag 0x8 term, 0x10 vote, 0x18 commit — all always
+    # emitted. term=1 vote=2 commit=300 (300 = 0xAC 0x02 varint).
+    got = gw.encode_state(pb.State(term=1, vote=2, commit=300))
+    assert got == bytes([0x08, 1, 0x10, 2, 0x18, 0xAC, 0x02])
+    # zero state still emits all three fields (gogo nullable=false)
+    assert gw.encode_state(pb.State()) == bytes([0x08, 0, 0x10, 0, 0x18, 0])
+
+
+def test_golden_entry_colfer():
+    # raft_optimized.go:166-301. Fields: 0 term, 1 index, 2 type,
+    # 3 key, 4 client_id, 5 series_id, 6 responded_to, 7 cmd; zero
+    # fields skipped; terminator 0x7f.
+    # Entry{Term:5, Index:300, Cmd:"ab"}:
+    #   term  -> 0x00 0x05
+    #   index -> 0x01 0xAC 0x02          (300 = 0b1_0101100)
+    #   cmd   -> 0x07 0x02 'a' 'b'
+    #   term  terminator 0x7f
+    e = pb.Entry(term=5, index=300, cmd=b"ab")
+    assert gw.encode_entry(e) == bytes(
+        [0x00, 0x05, 0x01, 0xAC, 0x02, 0x07, 0x02]) + b"ab\x7f"
+    # empty entry is just the terminator
+    assert gw.encode_entry(pb.Entry()) == b"\x7f"
+    # the >= 2**49 arm: header|0x80 + 8-byte BIG-endian fixed
+    # (raft_optimized.go:170-172, intconv = binary.BigEndian)
+    big = 1 << 49
+    e = pb.Entry(term=big)
+    assert gw.encode_entry(e) == bytes(
+        [0x80, 0x00, 0x02, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x7F])
+    # 2**49 - 1 still rides the varint arm (7 groups of 7 bits)
+    e = pb.Entry(term=(1 << 49) - 1)
+    assert gw.encode_entry(e) == bytes(
+        [0x00] + [0xFF] * 6 + [0x7F, 0x7F])
+
+
+def test_golden_entry_type_field():
+    # type (field 2) is int32: positive -> plain header 2 + varint
+    # (raft_optimized.go:201-218)
+    e = pb.Entry(type=pb.EntryType.CONFIG_CHANGE)     # enum value 1
+    assert gw.encode_entry(e) == bytes([0x02, 0x01, 0x7F])
+
+
+def test_golden_message():
+    # message.go:32-96: thirteen fields, scalars always emitted,
+    # entries length-delimited Colfer at tag 0x5a, snapshot at 0x62.
+    m = pb.Message(type=pb.MessageType.HEARTBEAT, to=2, from_=1,
+                   shard_id=7, term=3, log_term=0, log_index=0,
+                   commit=9, reject=False, hint=0, hint_high=0)
+    snap = gw.encode_snapshot(pb.Snapshot())
+    want = bytes([
+        0x08, 17,      # type Heartbeat
+        0x10, 2,       # to
+        0x18, 1,       # from
+        0x20, 7,       # shard_id (ClusterId)
+        0x28, 3,       # term
+        0x30, 0,       # log_term
+        0x38, 0,       # log_index
+        0x40, 9,       # commit
+        0x48, 0,       # reject=false
+        0x50, 0,       # hint
+        0x62, len(snap)]) + snap + bytes([0x68, 0])
+    assert gw.encode_message(m) == want
+
+
+def test_golden_membership_map_entry():
+    # membership.go:34-51: ccid at 0x8; each addresses entry at 0x12
+    # wrapping {0x8 key, 0x12 value}
+    m = pb.Membership(config_change_id=4, addresses={1: "a"})
+    want = bytes([
+        0x08, 4,
+        0x12, 5,            # map entry, 5 bytes
+        0x08, 1,            # key = 1
+        0x12, 1]) + b"a"    # value = "a"
+    assert gw.encode_membership(m) == want
+
+
+def test_golden_message_batch():
+    # messagebatch.go:23-51: requests(0xa), deployment_id(0x10),
+    # source_address(0x1a), bin_ver(0x20)
+    got = gw.encode_message_batch([], deployment_id=5,
+                                  source_address="x:1", bin_ver=2)
+    assert got == bytes([0x10, 5, 0x1A, 3]) + b"x:1" + bytes([0x20, 2])
+
+
+# --------------------------------------------------------------------------
+# 2. protobuf cross-oracle
+# --------------------------------------------------------------------------
+
+
+def _oracle():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "gowire_oracle"))
+    import raft_oracle_pb2
+
+    return raft_oracle_pb2
+
+
+def test_oracle_parses_gowire_message():
+    po = _oracle()
+    m = pb.Message(
+        type=pb.MessageType.REPLICATE, to=3, from_=1, shard_id=99,
+        term=7, log_term=6, log_index=41, commit=40, reject=True,
+        hint=11, hint_high=12,
+        entries=(pb.Entry(term=7, index=42, cmd=b"payload"),),
+        snapshot=pb.Snapshot(index=5, term=2, shard_id=99,
+                             membership=pb.Membership(
+                                 config_change_id=3,
+                                 addresses={1: "a:1", 2: "b:2"},
+                                 removed={9: True})),
+    )
+    parsed = po.Message()
+    parsed.ParseFromString(gw.encode_message(m))
+    assert parsed.type == 12 and parsed.to == 3 and getattr(
+        parsed, "from") == 1
+    assert parsed.shard_id == 99 and parsed.term == 7
+    assert parsed.log_term == 6 and parsed.log_index == 41
+    assert parsed.commit == 40 and parsed.reject is True
+    assert parsed.hint == 11 and parsed.hint_high == 12
+    assert len(parsed.entries) == 1
+    assert gw.decode_entry(parsed.entries[0]).cmd == b"payload"
+    assert parsed.snapshot.index == 5
+    assert dict(parsed.snapshot.membership.addresses) == {1: "a:1", 2: "b:2"}
+    assert dict(parsed.snapshot.membership.removed) == {9: True}
+
+
+def test_gowire_decodes_oracle_serialization():
+    po = _oracle()
+    om = po.Message()
+    om.type = 17
+    om.to = 2
+    setattr(om, "from", 5)
+    om.shard_id = 1
+    om.term = 9
+    om.commit = 33
+    om.reject = True
+    om.hint = 4
+    om.entries.append(gw.encode_entry(pb.Entry(term=9, index=34, cmd=b"z")))
+    om.snapshot.index = 3
+    om.snapshot.membership.addresses[1] = "h:1"
+    om.hint_high = 8
+    m = gw.decode_message(om.SerializeToString())
+    assert m.type == pb.MessageType.HEARTBEAT
+    assert m.to == 2 and m.from_ == 5 and m.term == 9
+    assert m.commit == 33 and m.reject and m.hint == 4 and m.hint_high == 8
+    assert m.entries[0].index == 34 and m.entries[0].cmd == b"z"
+    assert m.snapshot.index == 3
+    assert m.snapshot.membership.addresses == {1: "h:1"}
+
+
+def test_oracle_roundtrip_batch_and_snapshot():
+    po = _oracle()
+    msgs = [pb.Message(type=pb.MessageType.REPLICATE_RESP, to=1, from_=2,
+                       shard_id=i, term=3, log_index=i * 7)
+            for i in range(4)]
+    blob = gw.encode_message_batch(msgs, deployment_id=77,
+                                   source_address="nh:900", bin_ver=1)
+    parsed = po.MessageBatch()
+    parsed.ParseFromString(blob)
+    assert len(parsed.requests) == 4
+    assert parsed.deployment_id == 77
+    assert parsed.source_address == "nh:900"
+    assert parsed.bin_ver == 1
+    assert parsed.requests[2].shard_id == 2
+    # and back through gowire
+    reqs, dep, src, ver = gw.decode_message_batch(
+        parsed.SerializeToString())
+    assert len(reqs) == 4 and dep == 77 and src == "nh:900" and ver == 1
+    assert reqs[3].log_index == 21
+
+    s = pb.Snapshot(filepath="/x/y", file_size=10, index=9, term=2,
+                    shard_id=5, dummy=True, witness=True,
+                    on_disk_index=7, checksum=b"\x01\x02",
+                    files=(pb.SnapshotFile(file_id=3, filepath="/f",
+                                           metadata=b"m", file_size=2),),
+                    type=pb.StateMachineType.ON_DISK)
+    ps = po.Snapshot()
+    ps.ParseFromString(gw.encode_snapshot(s))
+    assert ps.filepath == "/x/y" and ps.index == 9 and ps.dummy
+    assert ps.witness and ps.on_disk_index == 7 and ps.type == 3
+    assert ps.files[0].file_id == 3 and ps.files[0].metadata == b"m"
+    s2 = gw.decode_snapshot(ps.SerializeToString())
+    assert s2 == s
+
+
+# --------------------------------------------------------------------------
+# 3. round-trips + robustness
+# --------------------------------------------------------------------------
+
+
+def test_entry_roundtrip_randomized():
+    rng = random.Random(7)
+    for _ in range(300):
+        e = pb.Entry(
+            term=rng.choice([0, 1, 127, 128, 1 << 20, (1 << 49) - 1,
+                             1 << 49, (1 << 64) - 1]),
+            index=rng.randrange(1 << 50),
+            type=rng.choice(list(pb.EntryType)),
+            key=rng.randrange(1 << 52),
+            client_id=rng.randrange(1 << 30),
+            series_id=rng.randrange(1 << 16),
+            responded_to=rng.randrange(1 << 8),
+            cmd=bytes(rng.randrange(256)
+                      for _ in range(rng.randrange(0, 40))),
+        )
+        assert gw.decode_entry(gw.encode_entry(e)) == e
+
+
+def test_state_membership_roundtrip():
+    s = pb.State(term=(1 << 63) + 5, vote=3, commit=0)
+    assert gw.decode_state(gw.encode_state(s)) == s
+    m = pb.Membership(config_change_id=9,
+                      addresses={1: "a", 300: "b" * 50},
+                      removed={7: True, 8: False},
+                      non_votings={2: "nv"}, witnesses={4: "w"})
+    got = gw.decode_membership(gw.encode_membership(m))
+    assert got == m
+
+
+def test_entry_batch_roundtrip():
+    ents = tuple(pb.Entry(term=1, index=i, cmd=bytes([i])
+                          ) for i in range(1, 20))
+    assert gw.decode_entry_batch(gw.encode_entry_batch(ents)) == ents
+
+
+def test_truncation_raises():
+    e = pb.Entry(term=5, index=300, cmd=b"abcdef")
+    blob = gw.encode_entry(e)
+    for cut in range(1, len(blob)):
+        with pytest.raises(ValueError):
+            gw.decode_entry(blob[:cut])
+    m = gw.encode_message(pb.Message(type=pb.MessageType.HEARTBEAT, to=1))
+    for cut in (1, 3, len(m) // 2, len(m) - 1):
+        try:
+            gw.decode_message(m[:cut])
+        except ValueError:
+            pass   # raising is fine; silently wrong values are not
+        # (protobuf prefixes can decode as a valid shorter message)
+
+
+def test_unknown_fields_skipped():
+    # forward compat: an unknown field (100, varint) must be skipped
+    blob = gw.encode_state(pb.State(term=1, vote=2, commit=3))
+    extra = bytearray(blob)
+    # field 100, wire 0: key = 800 -> varint A0 06; value 42
+    extra += bytes([0xA0, 0x06, 0x2A])
+    s = gw.decode_state(bytes(extra))
+    assert s == pb.State(term=1, vote=2, commit=3)

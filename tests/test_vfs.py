@@ -132,7 +132,7 @@ def test_cluster_on_memfs_and_crash_recovery(tmp_path):
     hosts = {}
     for rid, addr in addrs.items():
         nh = NodeHost(_mem_cfg(addr, fs, base))
-        assert nh.logdb.name() == "tan"
+        assert nh.logdb.name().startswith("sharded-tan")
         nh.start_replica(addrs, False, KVStateMachine, Config(
             shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1))
         hosts[rid] = nh
