@@ -124,8 +124,10 @@ def _pctile(hist, q: float):
 
 def _run_storm(platform: str) -> dict:
     """BASELINE config #4: election storm with randomized drops +
-    pre-vote across BENCH_STORM_GROUPS shards (default 10k; the CPU
-    fallback crunches the batch serially so it defaults smaller)."""
+    pre-vote across BENCH_STORM_GROUPS shards — 10k by default on every
+    platform (the CPU fallback pays the wall cost; shrinking the config
+    made r3's number incomparable to the baseline).  ``platform`` rides
+    into the record for provenance."""
     import time as _t
 
     import numpy as np
@@ -141,8 +143,9 @@ def _run_storm(platform: str) -> dict:
     import jax.numpy as jnp
 
     replicas = 3
-    default_g = "10000" if platform != "cpu" else "4096"
-    g = int(os.environ.get("BENCH_STORM_GROUPS", default_g))
+    # config #4 says 10k shards; the CPU fallback pays the wall cost
+    # rather than shrinking the config (VERDICT r3 weak #5)
+    g = int(os.environ.get("BENCH_STORM_GROUPS", "10000"))
     storm_steps = int(os.environ.get("BENCH_STORM_STEPS", "30"))
     drop_p = float(os.environ.get("BENCH_STORM_DROP", "0.25"))
     kp = bench_params(replicas)
@@ -178,11 +181,16 @@ def _run_storm(platform: str) -> dict:
             break
     dt = _t.time() - t0
     step_ms = dt / max(done, 1) * 1e3
+    # recovery is only complete at EXACTLY one leader per group
+    post_cov = float(((role == KP.LEADER).sum(axis=1) == 1).mean())
     return {
         "groups": g,
+        "platform": platform,
         "storm_steps": storm_steps,
         "drop_p": drop_p,
         "leader_coverage_after_storm": round(storm_coverage, 4),
+        "post_recovery_coverage": round(post_cov, 4),
+        "recovered": recovered_steps is not None,
         "recovery_steps": recovered_steps,
         # null when the cluster never reached one-leader-everywhere — a
         # 400-step timeout must not read as an achieved latency
@@ -365,17 +373,27 @@ def _measure(platform: str, groups: int, steps: int) -> None:
         lat_ms["instrumented_step_ms"] = round(lat_step_ms, 3)
         detail["commit_latency_ms"] = lat_ms
 
-        # ---- phase B: 9:1 read:write mix over ReadIndex (config #3) ----
+        # ---- phase B: 9:1 read:write mix over ReadIndex (config #3) —
+        # measured on the UNinstrumented mixed loop (run_steps_mixed):
+        # reads are counted by the completed-ctx carry, not the stamp
+        # ring, so the number is apples-to-apples with phase A ----
+        from dragonboat_tpu.bench_loop import run_steps_mixed
+
         mixed_steps = int(os.environ.get(
             "BENCH_MIXED_STEPS", str(max(40, steps // 2))))
         WW = max(1, B // 8)          # narrow writes; reads dominate
 
+        def mixed_run(iters):
+            nonlocal state, box, reads, now
+            state, box, reads = run_steps_mixed(
+                kp, replicas, iters, WW, jnp.asarray(now, jnp.int32),
+                state, box, reads)
+            now += iters
+
         def snap_mixed():
             snaps["reads0"], snaps["cB0"] = int(np.asarray(reads)), committed()
 
-        _, dtB = timed_window(
-            lambda n: lat_run(n, WW, True, True, True), mixed_steps,
-            snap_mixed)
+        _, dtB = timed_window(mixed_run, mixed_steps, snap_mixed)
         writes_b = int(committed() - snaps["cB0"])
         ctx = int(np.asarray(reads)) - snaps["reads0"]
         # one ReadIndex ctx serves the read batch queued behind it

@@ -145,6 +145,32 @@ def run_steps(kp: KP.KernelParams, replicas: int, iters: int,
     return jax.lax.fori_loop(0, iters, body, (state, box))
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def run_steps_mixed(kp: KP.KernelParams, replicas: int, iters: int,
+                    write_width: int, now0, state: ShardState, box: Inbox,
+                    reads):
+    """The mixed read/write loop WITHOUT latency instrumentation: writes
+    narrowed to ``write_width`` lanes, one batched ReadIndex ctx per
+    leader per step, and the only extra carry is the completed-ctx
+    counter (an [RI]-bool sum — nothing like the stamp ring's one-hot
+    writes).  Exists because measuring the 9:1 mix on the instrumented
+    loop conflated ReadIndex cost with latency-capture cost (~2x).
+    Deliberately a separate loop rather than an ``instrument`` flag on
+    ``run_steps_lat``: the [G, log_cap] stamp ring would still ride the
+    fori_loop carry, and whether XLA fully elides an untouched carry is
+    exactly the kind of backend detail a benchmark must not bet on."""
+
+    def body(i, carry):
+        st, bx, rd = carry
+        inp = _self_input(kp, st, True, True, write_width, True, now0 + i)
+        st, out = step(kp, st, bx, inp)
+        bx = route(kp, replicas, out)
+        rd = rd + out.rtr_valid.sum(dtype=jnp.int32)
+        return st, bx, rd
+
+    return jax.lax.fori_loop(0, iters, body, (state, box, reads))
+
+
 # ---------------------------------------------------------------------------
 # device-SM pipeline: the full propose -> replicate -> commit -> APPLY loop
 # with the rsm-apply kernel (rsm/device_kv.py) fused into the step
