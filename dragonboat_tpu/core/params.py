@@ -31,6 +31,14 @@ class KernelParams:
     # inline payload lanes (lv ring + ent_val routing) for device-resident
     # RSMs; off by default — host-side-payload deployments skip the cost
     inline_payloads: bool = False
+    # process the ring-invariant inbox families (resp/hb/vote) as one
+    # unrolled fused pass instead of serial lax.scans.  Removes 8 of 10
+    # serial inbox segments per step — the TPU roofline's top lever —
+    # but measured 28x SLOWER on XLA:CPU (the rolled scan's aliased
+    # carry updates in place; the unrolled chain materializes fresh
+    # buffers), so it is opt-in pending an on-device measurement.
+    # Bitwise-identical to the scan either way (differential-tested).
+    merge_inbox_families: bool = False
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

@@ -241,6 +241,9 @@ class KernelEngine:
         # injection/membership updates, so the output path must not pay a
         # device->host transfer for them every step
         self._kind_np = np.zeros((capacity, kp.num_peers), np.int32)
+        # admissions queued for the next step's batched injection
+        # (lane -> (node, init, pids, kinds)); see _flush_injections
+        self._pending_inject: dict[int, tuple] = {}
         # persistent staging buffers, zeroed per step (the jitted step
         # needs fixed [capacity] shapes anyway; reallocating every engine
         # iteration would cost ~G*K*E ints of fresh numpy per step)
@@ -282,83 +285,145 @@ class KernelEngine:
         return node
 
     def _inject(self, lane: int, node: KernelNode, init: _LaneInit) -> None:
-        """Write one lane of device state from persisted shard state."""
+        """Queue one lane injection; the next ``step_all`` flushes every
+        queued lane in ONE vectorized state update.  The eager form was
+        ~30 full-[capacity] array copies PER admission — O(n·capacity)
+        total, the first structure to fall over at 100k groups.  Host
+        bookkeeping (kind cache, payload mirror, writeback triple) is
+        done here so non-state readers see the shard immediately."""
         kp = self.kp
         pids = np.zeros((kp.num_peers,), np.int32)
         kinds = np.zeros((kp.num_peers,), np.int32)
         for i, (rid, kind) in enumerate(init.peers[:kp.num_peers]):
             pids[i], kinds[i] = rid, kind
         self._kind_np[lane] = kinds
-        lt = np.zeros((kp.log_cap,), np.int32)
-        lcc = np.zeros((kp.log_cap,), bool)
         for e in init.entries:
-            lt[e.index & (kp.log_cap - 1)] = e.term
-            lcc[e.index & (kp.log_cap - 1)] = e.is_config_change()
             node.mirror[e.index] = e
-        last = init.entries[-1].index if init.entries else init.snap_index
+        self._last_state_triple[lane] = (init.term, init.vote,
+                                         init.committed)
+        self._pending_inject[lane] = (node, init, pids, kinds)
+
+    def _flush_injections(self) -> None:
+        """One ``.at[lanes].set`` per state field for every admission
+        queued since the last step — O(capacity + n) instead of
+        O(n·capacity)."""
+        if not self._pending_inject:
+            return
+        kp = self.kp
+        items = sorted(self._pending_inject.items())
+        self._pending_inject = {}
+        n = len(items)
+        lanes = jnp.asarray(np.array([g for g, _ in items], np.int32))
+        f32 = {k: np.zeros((n,), np.int32) for k in (
+            "replica_id", "seed", "rand_timeout", "e_timeout", "h_timeout",
+            "role", "term", "vote", "applied", "snap_index", "snap_term",
+            "last", "committed")}
+        fb = {k: np.zeros((n,), bool) for k in ("check_quorum", "pre_vote")}
+        pid_rows = np.zeros((n, kp.num_peers), np.int32)
+        kind_rows = np.zeros((n, kp.num_peers), np.int32)
+        lt_rows = np.zeros((n, kp.log_cap), np.int32)
+        lcc_rows = np.zeros((n, kp.log_cap), bool)
+        for j, (lane, (node, init, pids, kinds)) in enumerate(items):
+            pid_rows[j], kind_rows[j] = pids, kinds
+            for e in init.entries:
+                lt_rows[j, e.index & (kp.log_cap - 1)] = e.term
+                lcc_rows[j, e.index & (kp.log_cap - 1)] = \
+                    e.is_config_change()
+            last = init.entries[-1].index if init.entries \
+                else init.snap_index
+            role = KP.FOLLOWER
+            my_kind = dict(init.peers).get(node.replica_id, KP.K_VOTER)
+            if my_kind == KP.K_NON_VOTING:
+                role = KP.NON_VOTING
+            elif my_kind == KP.K_WITNESS:
+                role = KP.WITNESS
+            cfg = node.cfg
+            # per-(shard, replica) PRNG stream: lanes injected on
+            # different hosts must NOT share election-timeout sequences
+            # or symmetric campaigns livelock (randomizedElectionTimeout,
+            # raft.go:659)
+            seed = int(KP.splitmix32(
+                (node.shard_id * 2654435761 + node.replica_id * 40503)
+                & 0xFFFFFFFF)) & 0x7FFFFFFF
+            f32["replica_id"][j] = node.replica_id
+            f32["seed"][j] = seed
+            f32["rand_timeout"][j] = KP.randomized_timeout(
+                seed, 0, cfg.election_rtt)
+            f32["e_timeout"][j] = cfg.election_rtt
+            f32["h_timeout"][j] = max(1, cfg.heartbeat_rtt)
+            fb["check_quorum"][j] = cfg.check_quorum
+            fb["pre_vote"][j] = cfg.pre_vote
+            f32["role"][j] = role
+            f32["term"][j] = init.term
+            f32["vote"][j] = init.vote
+            f32["applied"][j] = init.applied
+            f32["snap_index"][j] = init.snap_index
+            f32["snap_term"][j] = init.snap_term
+            f32["last"][j] = last
+            f32["committed"][j] = init.committed
         s = self.state
-        g = lane
+        A = {k: jnp.asarray(v) for k, v in {**f32, **fb}.items()}
 
-        def put(arr, val):
-            return arr.at[g].set(val)
+        def put(arr, vals):
+            # route sub-32-bit scatters through int32: non-uniform-index
+            # scatters on bool operands silently drop writes on TPU past
+            # ~3k rows (the _set1 miscompile, core/kernel.py) — an
+            # admission batch is exactly that shape
+            if arr.dtype == jnp.bool_:
+                vals_i = jnp.asarray(vals).astype(jnp.int32)
+                return (arr.astype(jnp.int32).at[lanes].set(vals_i)
+                        .astype(bool))
+            return arr.at[lanes].set(vals)
 
-        role = KP.FOLLOWER
-        my_kind = dict(init.peers).get(node.replica_id, KP.K_VOTER)
-        if my_kind == KP.K_NON_VOTING:
-            role = KP.NON_VOTING
-        elif my_kind == KP.K_WITNESS:
-            role = KP.WITNESS
-        cfg = node.cfg
-        # per-(shard, replica) PRNG stream: lanes injected on different
-        # hosts must NOT share election-timeout sequences or symmetric
-        # campaigns livelock (randomizedElectionTimeout, raft.go:659)
-        seed = int(KP.splitmix32(
-            (node.shard_id * 2654435761 + node.replica_id * 40503)
-            & 0xFFFFFFFF)) & 0x7FFFFFFF
-        rand0 = KP.randomized_timeout(seed, 0, cfg.election_rtt)
+        last_v = A["last"]
         self.state = s._replace(
-            replica_id=put(s.replica_id, node.replica_id),
-            seed=put(s.seed, seed),
-            rand_timeout=put(s.rand_timeout, rand0),
+            replica_id=put(s.replica_id, A["replica_id"]),
+            seed=put(s.seed, A["seed"]),
+            rand_timeout=put(s.rand_timeout, A["rand_timeout"]),
             rand_counter=put(s.rand_counter, 0),
-            e_timeout=put(s.e_timeout, cfg.election_rtt),
-            h_timeout=put(s.h_timeout, max(1, cfg.heartbeat_rtt)),
-            check_quorum=put(s.check_quorum, cfg.check_quorum),
-            pre_vote=put(s.pre_vote, cfg.pre_vote),
-            role=put(s.role, role),
-            term=put(s.term, init.term),
-            vote=put(s.vote, init.vote),
+            e_timeout=put(s.e_timeout, A["e_timeout"]),
+            h_timeout=put(s.h_timeout, A["h_timeout"]),
+            check_quorum=put(s.check_quorum, A["check_quorum"]),
+            pre_vote=put(s.pre_vote, A["pre_vote"]),
+            role=put(s.role, A["role"]),
+            term=put(s.term, A["term"]),
+            vote=put(s.vote, A["vote"]),
             leader=put(s.leader, 0),
-            applied=put(s.applied, init.applied),
+            applied=put(s.applied, A["applied"]),
             e_tick=put(s.e_tick, 0),
             h_tick=put(s.h_tick, 0),
             pending_cc=put(s.pending_cc, False),
             ltt=put(s.ltt, 0),
             is_ltt=put(s.is_ltt, False),
-            pid=s.pid.at[g].set(jnp.asarray(pids)),
-            kind=s.kind.at[g].set(jnp.asarray(kinds)),
-            match=s.match.at[g].set(0),
-            next=s.next.at[g].set(last + 1),
-            pstate=s.pstate.at[g].set(KP.R_RETRY),
-            active=s.active.at[g].set(False),
-            psnap=s.psnap.at[g].set(0),
-            vresp=s.vresp.at[g].set(False),
-            vgrant=s.vgrant.at[g].set(False),
-            lt=s.lt.at[g].set(jnp.asarray(lt)),
-            lcc=s.lcc.at[g].set(jnp.asarray(lcc)),
-            snap_index=put(s.snap_index, init.snap_index),
-            snap_term=put(s.snap_term, init.snap_term),
-            last=put(s.last, last),
-            committed=put(s.committed, init.committed),
-            processed=put(s.processed, init.applied),
-            stable=put(s.stable, last),
+            pid=put(s.pid, jnp.asarray(pid_rows)),
+            kind=put(s.kind, jnp.asarray(kind_rows)),
+            match=put(s.match, 0),
+            next=put(s.next, (last_v + 1)[:, None]),
+            pstate=put(s.pstate, KP.R_RETRY),
+            active=put(s.active, False),
+            psnap=put(s.psnap, 0),
+            vresp=put(s.vresp, False),
+            vgrant=put(s.vgrant, False),
+            lt=put(s.lt, jnp.asarray(lt_rows)),
+            lcc=put(s.lcc, jnp.asarray(lcc_rows)),
+            snap_index=put(s.snap_index, A["snap_index"]),
+            snap_term=put(s.snap_term, A["snap_term"]),
+            last=put(s.last, last_v),
+            committed=put(s.committed, A["committed"]),
+            processed=put(s.processed, A["applied"]),
+            stable=put(s.stable, last_v),
             ri_head=put(s.ri_head, 0),
             ri_count=put(s.ri_count, 0),
             needs_host=put(s.needs_host, False),
         )
-        self._last_state_triple[lane] = (init.term, init.vote, init.committed)
 
     def _clear_lane(self, lane: int) -> None:
+        if self._pending_inject.pop(lane, None) is not None:
+            # evicted before its injection ever flushed: the lane state
+            # was never written, so there is nothing to clear on device
+            self._kind_np[lane] = KP.K_ABSENT
+            self._last_state_triple.pop(lane, None)
+            return
         s = self.state
         self.state = s._replace(
             kind=s.kind.at[lane].set(KP.K_ABSENT),
@@ -420,6 +485,7 @@ class KernelEngine:
             nodes = dict(self.nodes)
             if not nodes:
                 return False
+            self._flush_injections()
             inbox = self._inbox_buf
             inp = self._input_buf
             inbox.reset()

@@ -44,11 +44,27 @@ state, box = run_steps(kp, 3, N, True, True, state, box)
 jax.block_until_ready(state.term)
 dt = time.time() - t0
 wps = {g} * 28 / (dt / N)   # 28 committed writes per group-step (bench width)
-print("RUNG " + json.dumps({{
+rec = {{
     "ts": time.time(), "platform": plat, "groups": G,
     "setup_s": round(setup_s, 1), "compile_s": round(compile_s, 1),
     "step_ms": round(dt / N * 1000, 3), "writes_per_s": int(wps),
-}}))
+}}
+# A/B the unrolled inbox families (KernelParams.merge_inbox_families):
+# 28x slower on XLA:CPU, but built for exactly this device's serial
+# launch overhead — the rung records both so the flag decision is data
+try:
+    import dataclasses
+    kpm = dataclasses.replace(kp, merge_inbox_families=True)
+    state2, box2 = elect_all(kpm, 3, make_cluster(kpm, G, 3))
+    state2, box2 = run_steps(kpm, 3, 4, True, True, state2, box2)
+    jax.block_until_ready(state2.term)
+    t0 = time.time()
+    state2, box2 = run_steps(kpm, 3, N, True, True, state2, box2)
+    jax.block_until_ready(state2.term)
+    rec["merged_step_ms"] = round((time.time() - t0) / N * 1000, 3)
+except Exception as e:   # the plain rung must survive a merged failure
+    rec["merged_error"] = str(e)[-200:]
+print("RUNG " + json.dumps(rec))
 """
 
 

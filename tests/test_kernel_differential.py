@@ -146,11 +146,12 @@ class DiffCluster:
     """Drives KernelCluster + PyMirror on one schedule."""
 
     def __init__(self, groups=2, replicas=3, election=10, heartbeat=1,
-                 check_quorum=False, pre_vote=False, witnesses=frozenset()):
+                 check_quorum=False, pre_vote=False, witnesses=frozenset(),
+                 kp=None):
         self.kc = KernelCluster(groups, replicas, election=election,
                                 heartbeat=heartbeat,
                                 check_quorum=check_quorum, pre_vote=pre_vote,
-                                witnesses=witnesses)
+                                witnesses=witnesses, kp=kp)
         self.pm = PyMirror(self.kc, election=election, heartbeat=heartbeat,
                            check_quorum=check_quorum, pre_vote=pre_vote)
         self.groups, self.replicas = groups, replicas
@@ -562,3 +563,49 @@ def test_diff_witness_randomized_trace(seed):
         _random_schedule(d, rng, step_no, partitions=False)
     d.settle()
     d.compare("witness-random-trace")
+
+
+@pytest.mark.parametrize("seed", [3, 21])
+def test_diff_merged_families_lockstep(seed):
+    """The opt-in unrolled inbox families (KernelParams
+    .merge_inbox_families — the TPU serial-segment lever) must stay
+    BITWISE identical to the scan path.  Driven kernel-vs-kernel over
+    the REAL typed router layout (bench_loop, K=10: resp/rep/hb/vote
+    slots all live — the pycore harness packs slots FIFO and would
+    leave the typed families empty): elect, then a seeded drop storm
+    (term bumps, vote tallies, leader transitions through the merged
+    pass), then a mixed read/write phase (heartbeat-resp ReadIndex
+    confirms), comparing every state leaf bitwise at each phase end."""
+    import dataclasses
+
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        make_cluster,
+        run_steps,
+        run_steps_mixed,
+        run_steps_storm,
+        elect_all,
+    )
+
+    def drive(kp):
+        state, box = elect_all(kp, 3, make_cluster(kp, 64, 3))
+        snaps = [jax.tree_util.tree_map(np.asarray, state)]
+        state, box = run_steps_storm(kp, 3, 40, 0.25, seed, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box = run_steps(kp, 3, 30, True, True, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box, _ = run_steps_mixed(
+            kp, 3, 20, max(1, kp.proposal_cap // 8),
+            np.int32(7), state, box, np.int32(0))
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        return snaps
+
+    kp = bench_params(3)
+    a = drive(kp)
+    b = drive(dataclasses.replace(kp, merge_inbox_families=True))
+    for phase, (sa, sb) in enumerate(zip(a, b)):
+        for name, va, vb in zip(sa._fields, sa, sb):
+            assert np.array_equal(va, vb), \
+                f"phase {phase} field {name} diverged (seed {seed})"
