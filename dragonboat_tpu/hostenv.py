@@ -78,3 +78,22 @@ def clean_cpu_env(n_devices: int | None = None, **extra: str) -> dict:
         )
     env.update(extra)
     return env
+
+
+def jax_cache_dir(prefix: str = "/tmp/dragonboat_tpu_jax_cache") -> str:
+    """Persistent-compile-cache dir fingerprinted by CPU features.
+
+    Build rounds hop machines; artifacts compiled for another feature
+    set at best load with warnings.  x86 exposes a ``flags`` line in
+    /proc/cpuinfo, aarch64 a ``Features`` line; anything else hashes
+    empty and shares one dir (acceptable: same-arch fallback)."""
+    import hashlib
+
+    line = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            line = next((ln for ln in f
+                         if ln.startswith(("flags", "Features"))), "")
+    except OSError:
+        pass
+    return f"{prefix}_{hashlib.md5(line.encode()).hexdigest()[:8]}"

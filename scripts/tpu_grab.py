@@ -24,7 +24,8 @@ RUNG = r"""
 import os, sys, time, json
 sys.path.insert(0, {repo!r})
 import jax
-jax.config.update("jax_compilation_cache_dir", "/tmp/dragonboat_tpu_jax_cache")
+from dragonboat_tpu.hostenv import jax_cache_dir
+jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 plat = jax.devices()[0].platform
 from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps, elect_all

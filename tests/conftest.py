@@ -37,8 +37,11 @@ for _plat in ("axon",):
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
 # persistent compile cache: the batched step kernel takes ~10-30s to compile;
-# cache it across pytest runs
-jax.config.update("jax_compilation_cache_dir", "/tmp/dragonboat_tpu_jax_cache")
+# cache it across pytest runs.  The dir is fingerprinted by CPU features
+# (build rounds hop machines — hostenv.jax_cache_dir)
+from dragonboat_tpu.hostenv import jax_cache_dir as _jax_cache_dir
+
+jax.config.update("jax_compilation_cache_dir", _jax_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

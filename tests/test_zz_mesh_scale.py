@@ -42,9 +42,25 @@ def test_mesh_256_groups_8_devices_mixed_residency_concurrent_ops():
             hosts[rid] = nh
         for rid, nh in hosts.items():
             for sid in mesh_shards:
+                # shard 70 will be CC-evicted to the HOST engines mid-test
+                # and keeps its config there: it gets a timeout that works
+                # in both regimes (see host_rtt note below) — on the mesh,
+                # ticks coalesce to ~1/step so this only delays its own
+                # first election by ~100 steps inside the 600 s window
+                e_rtt, hb_rtt = (100, 10) if sid == 70 else (10, 2)
                 nh.start_replica(addrs, False, KVStateMachine, Config(
-                    shard_id=sid, replica_id=rid, election_rtt=10,
-                    heartbeat_rtt=2, mesh_resident=True))
+                    shard_id=sid, replica_id=rid, election_rtt=e_rtt,
+                    heartbeat_rtt=hb_rtt, mesh_resident=True))
+        # Election timeouts for NON-mesh shards are sized to this box's
+        # step granularity: with 256 mesh groups one worker iteration
+        # takes ~1 s, but wall-clock ticks accrue every 10 ms — a 10-rtt
+        # timeout delivers ~100 expired ticks per step, so every
+        # replica campaigns EVERY step and elections never converge
+        # (mesh lanes are immune: all replicas of a group advance in the
+        # same device step, so their relative timers stay coherent).
+        # 150 rtt ≈ 1.5 s spans a couple of iterations and the random
+        # spread resolves the race.
+        host_rtt = dict(election_rtt=150, heartbeat_rtt=15)
         # mixed residency: device-resident kernel shards on hosts 1-3
         k_addrs = {i: addrs[i] for i in (1, 2, 3)}
         for rid in (1, 2, 3):
@@ -52,18 +68,17 @@ def test_mesh_256_groups_8_devices_mixed_residency_concurrent_ops():
                 hosts[rid].start_replica(k_addrs, False, KVStateMachine,
                                          Config(shard_id=sid,
                                                 replica_id=rid,
-                                                election_rtt=10,
+                                                election_rtt=20,
                                                 heartbeat_rtt=2,
                                                 device_resident=True))
         # witness-bearing group: voters on hosts 1-2, witness on host 3
         w_addrs = {i: addrs[i] for i in (1, 2, 3)}
         for rid in (1, 2):
             hosts[rid].start_replica(w_addrs, False, KVStateMachine, Config(
-                shard_id=witness_shard, replica_id=rid, election_rtt=10,
-                heartbeat_rtt=2))
+                shard_id=witness_shard, replica_id=rid, **host_rtt))
         hosts[3].start_replica(w_addrs, False, KVStateMachine, Config(
-            shard_id=witness_shard, replica_id=3, election_rtt=10,
-            heartbeat_rtt=2, is_witness=True))
+            shard_id=witness_shard, replica_id=3, is_witness=True,
+            **host_rtt))
 
         # -- every mesh group elects through the all_gather step --------
         deadline = time.time() + 600
@@ -80,6 +95,13 @@ def test_mesh_256_groups_8_devices_mixed_residency_concurrent_ops():
             resident = sum(1 for sid in mesh_shards
                            if (sid, rid) in nh.mesh_engine.by_shard)
             assert resident == N_MESH
+        # mesh step time at this geometry, for PERF.md (captured while
+        # the mesh is at full residency)
+        m = hosts[1].metrics()
+        print(f"\nMESH_STEP_US ewma={m.get('engine.kernel_step.ewma_us', 0)}"
+              f" max={m.get('engine.kernel_step.max_us', 0)}"
+              f" at rows={spec.g_size * REPLICAS * spec.n_local}",
+              flush=True)
 
         # -- concurrent: proposals + snapshot + CC-driven eviction ------
         errors = []
@@ -155,23 +177,46 @@ def test_mesh_256_groups_8_devices_mixed_residency_concurrent_ops():
                 break
             time.sleep(0.5)
         assert off_mesh, "shard 70 still mesh-resident after CC"
-        lid = wait_leader(hosts, shard_id=70, timeout=120)
-        assert hosts[lid].sync_read(70, "pre", timeout_s=60) == "cc"
+        # ONE worker thread services the [1024]-row mesh step AND every
+        # host-path node on this box, so the evicted group's re-election
+        # progresses one message round per ~1s engine iteration — give
+        # it the time that implies
+        try:
+            lid = wait_leader(hosts, shard_id=70, timeout=360)
+        except AssertionError:
+            for rid, nh in hosts.items():
+                n = nh.nodes.get(70)
+                print(f"DIAG host {rid}: node={type(n).__name__ if n else None}"
+                      f" leader={n.leader_id() if n else '-'}"
+                      f" term={n.node_term() if n else '-'}"
+                      f" inq={len(n.incoming_msgs) if n else '-'}",
+                      flush=True)
+            raise
+        end = time.time() + 180
+        while True:
+            try:
+                assert hosts[lid].sync_read(70, "pre", timeout_s=60) == "cc"
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                if time.time() > end:
+                    raise
+                time.sleep(1.0)
 
-        # witness + kernel shards served throughout
-        lid = wait_leader(hosts, shard_id=witness_shard, timeout=120)
+        # witness + kernel shards served throughout (wait on the hosts
+        # that CARRY the shard — host 4 never reports a leader for it,
+        # so a 4-host majority would demand all three carriers incl.
+        # the metadata-lagged witness)
+        lid = wait_leader({r: hosts[r] for r in (1, 2, 3)},
+                          shard_id=witness_shard, timeout=240)
         propose_retry(hosts[lid], hosts[lid].get_noop_session(witness_shard),
                       b"wit=ok", timeout_s=15, deadline_s=90)
         lid = wait_leader({r: hosts[r] for r in (1, 2, 3)},
-                          shard_id=301, timeout=120)
+                          shard_id=301, timeout=240)
         propose_retry(hosts[lid], hosts[lid].get_noop_session(301),
                       b"k=ok", timeout_s=15, deadline_s=90)
 
-        # -- mesh step time at this geometry, for PERF.md ---------------
-        m = hosts[1].metrics()
-        ewma = m.get("engine.kernel_step.ewma_us", 0)
-        print(f"\nMESH_STEP_US ewma={ewma} at rows="
-              f"{spec.g_size * REPLICAS * spec.n_local}")
     finally:
         for nh in hosts.values():
             nh.close()
