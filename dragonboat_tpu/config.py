@@ -76,9 +76,15 @@ class EngineConfig:
 class LogDBConfig:
     """Expert log-engine geometry (config/config.go:780,845): the durable
     log is split into ``shards`` single-writer partitions so concurrent
-    step workers flush different files (internal/logdb/sharded.go:34)."""
+    step workers flush different files (internal/logdb/sharded.go:34).
+
+    ``engine`` picks the per-partition storage engine — ``"tan"`` (the
+    purpose-built log-file engine, the default) or ``"kv"`` (the
+    sorted-KV LSM engine, the analog of the reference's Pebble logdb);
+    the choice is pinned into the on-disk layout on first open."""
 
     shards: int = 16
+    engine: str = "tan"
 
 
 @dataclass(frozen=True)

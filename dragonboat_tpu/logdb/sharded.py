@@ -54,20 +54,38 @@ class ShardedLogDB(ILogDB):
     """``num_shards`` TanLogDB partitions under one root directory."""
 
     def __init__(self, root_dir: str, num_shards: int = 16,
-                 max_file_size: int = 64 << 20, fs=None) -> None:
+                 max_file_size: int = 64 << 20, fs=None,
+                 engine: str = "tan") -> None:
         from dragonboat_tpu.vfs import default_fs
 
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
+        if engine not in ("tan", "kv"):
+            raise ValueError(f"unknown logdb engine {engine!r}")
         self.fs = fs if fs is not None else default_fs()
         self.root = root_dir
         self.num_shards = num_shards
+        self.engine = engine
         self.fs.makedirs(self.root)
+        # refuse a legacy layout under a non-tan engine BEFORE the marker
+        # is written: a persisted "kv" marker over tan data would make the
+        # directory unopenable under either engine
+        if self.engine != "tan" and self._legacy_files():
+            raise ShardGeometryError(
+                f"{self.root}: legacy flat tan layout cannot migrate to "
+                f"engine {self.engine!r}; open it as tan")
         self._check_marker()
         self._migrate_legacy(max_file_size)
+
+        def make_part(path: str):
+            if engine == "kv":
+                from dragonboat_tpu.logdb.kvdb import KVLogDB
+
+                return KVLogDB(path, fs=self.fs)
+            return TanLogDB(path, max_file_size=max_file_size, fs=self.fs)
+
         self._parts = [
-            TanLogDB(os.path.join(self.root, f"part-{i:02d}"),
-                     max_file_size=max_file_size, fs=self.fs)
+            make_part(os.path.join(self.root, f"part-{i:02d}"))
             for i in range(num_shards)
         ]
         # flush pool for batches that span partitions (device engine):
@@ -84,18 +102,30 @@ class ShardedLogDB(ILogDB):
     def _marker_path(self) -> str:
         return os.path.join(self.root, _MARKER)
 
+    def _legacy_files(self) -> list[str]:
+        """Pre-sharding flat tan log files directly in the root."""
+        return [fn for fn in self.fs.listdir(self.root)
+                if fn.startswith("log-") and fn.endswith(".tan")]
+
     def _check_marker(self) -> None:
         mp = self._marker_path()
         if self.fs.exists(mp):
             with self.fs.open(mp, "rb") as f:
-                want = f.read().decode("ascii").strip()
+                fields = f.read().decode("ascii").split()
+            want = fields[0]
+            # pre-engine markers carried only the count: they are tan dirs
+            want_engine = fields[1] if len(fields) > 1 else "tan"
             if want != str(self.num_shards):
                 raise ShardGeometryError(
                     f"{self.root}: on-disk shard count {want} != "
                     f"configured {self.num_shards}")
+            if want_engine != self.engine:
+                raise ShardGeometryError(
+                    f"{self.root}: on-disk engine {want_engine!r} != "
+                    f"configured {self.engine!r}")
         else:
             with self.fs.open(mp, "wb") as f:
-                f.write(f"{self.num_shards}\n".encode("ascii"))
+                f.write(f"{self.num_shards} {self.engine}\n".encode("ascii"))
                 self.fs.fsync(f)
 
     @staticmethod
@@ -107,12 +137,11 @@ class ShardedLogDB(ILogDB):
         if not fs.exists(mp):
             return None
         with fs.open(mp, "rb") as f:
-            return int(f.read().decode("ascii").strip())
+            return int(f.read().decode("ascii").split()[0])
 
     def _migrate_legacy(self, max_file_size: int) -> None:
         """Fold a pre-sharding flat layout into the partition dirs."""
-        legacy = [fn for fn in self.fs.listdir(self.root)
-                  if fn.startswith("log-") and fn.endswith(".tan")]
+        legacy = self._legacy_files()
         if not legacy:
             return
         old = TanLogDB(self.root, max_file_size=max_file_size, fs=self.fs)
@@ -156,13 +185,13 @@ class ShardedLogDB(ILogDB):
     def _pid(self, shard_id: int) -> int:
         return shard_id % self.num_shards
 
-    def _part(self, shard_id: int) -> TanLogDB:
+    def _part(self, shard_id: int) -> ILogDB:
         return self._parts[self._pid(shard_id)]
 
     # -- ILogDB ----------------------------------------------------------
 
     def name(self) -> str:
-        return f"sharded-tan-{self.num_shards}"
+        return f"sharded-{self.engine}-{self.num_shards}"
 
     def close(self) -> None:
         with self._close_mu:
@@ -242,12 +271,15 @@ class ShardedLogDBFactory:
     """config.LogDBFactory equivalent producing the sharded engine."""
 
     def __init__(self, root_dir: str, num_shards: int = 16,
-                 max_file_size: int = 64 << 20, fs=None) -> None:
+                 max_file_size: int = 64 << 20, fs=None,
+                 engine: str = "tan") -> None:
         self.root_dir = root_dir
         self.num_shards = num_shards
         self.max_file_size = max_file_size
         self.fs = fs
+        self.engine = engine
 
     def create(self) -> ShardedLogDB:
         return ShardedLogDB(self.root_dir, self.num_shards,
-                            self.max_file_size, fs=self.fs)
+                            self.max_file_size, fs=self.fs,
+                            engine=self.engine)

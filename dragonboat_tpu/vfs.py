@@ -63,6 +63,15 @@ class OSFS:
         f.flush()
         os.fsync(f.fileno())
 
+    def fsync_dir(self, path: str) -> None:
+        """Make directory-entry changes (rename/create/remove) durable —
+        required after ``replace`` before depending on the new name."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
     def flock_exclusive(self, f) -> None:
         """Non-blocking exclusive lock; OSError if held elsewhere."""
         import fcntl
@@ -246,6 +255,9 @@ class MemFS:
         with self._mu:
             f._node.synced = bytes(f._node.data)
 
+    def fsync_dir(self, path: str) -> None:
+        pass  # MemFS models renames as atomic+durable (see replace)
+
     def flock_exclusive(self, f) -> None:
         with self._mu:
             if f._path in self._locks:
@@ -371,6 +383,10 @@ class ErrorFS:
         inner = f._f if isinstance(f, _ErrFile) else f
         self._check("fsync", getattr(f, "_path", "?"))
         self.base.fsync(inner)
+
+    def fsync_dir(self, path: str) -> None:
+        self._check("fsync", path)
+        self.base.fsync_dir(path)
 
     def flock_exclusive(self, f) -> None:
         inner = f._f if isinstance(f, _ErrFile) else f

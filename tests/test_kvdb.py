@@ -1,0 +1,280 @@
+"""KVLogDB (sorted-KV LSM engine): the same contract scenarios as
+tests/test_tan.py — round-trips, crash recovery, conflict overwrite,
+compaction — plus LSM-specific coverage (memtable flush, SST merge,
+tombstone GC, torn-WAL truncation) and the sharded-kv geometry marker."""
+
+import os
+import struct
+
+import pytest
+
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.logdb.kv import _WAL_HDR, CorruptKVError, OrderedKV
+from dragonboat_tpu.logdb.kvdb import KVLogDB, KVLogDBFactory
+from dragonboat_tpu.logdb.sharded import ShardedLogDB, ShardGeometryError
+
+
+def _update(shard=1, replica=1, term=1, first=1, n=3, commit=0):
+    ents = tuple(
+        pb.Entry(term=term, index=first + i, cmd=f"e{first + i}".encode())
+        for i in range(n)
+    )
+    return pb.Update(
+        shard_id=shard, replica_id=replica,
+        state=pb.State(term=term, vote=2, commit=commit),
+        entries_to_save=ents,
+    )
+
+
+# ---------------------------------------------------------------------------
+# OrderedKV engine
+# ---------------------------------------------------------------------------
+
+
+def test_kv_roundtrip_and_restart(tmp_path):
+    kv = OrderedKV(str(tmp_path))
+    kv.put(b"b", b"2")
+    kv.put(b"a", b"1")
+    kv.put(b"c", b"3")
+    kv.delete(b"b")
+    assert kv.get(b"a") == b"1" and kv.get(b"b") is None
+    assert [k for k, _ in kv.scan(b"a", b"z")] == [b"a", b"c"]
+    kv.close()
+    kv2 = OrderedKV(str(tmp_path))
+    assert kv2.get(b"a") == b"1" and kv2.get(b"b") is None
+    assert [k for k, _ in kv2.scan(b"a", b"z")] == [b"a", b"c"]
+    kv2.close()
+
+
+def test_kv_flush_and_merge_newest_wins(tmp_path):
+    kv = OrderedKV(str(tmp_path), memtable_bytes=64)  # force flushes
+    for round_ in range(5):
+        for i in range(16):
+            kv.put(f"k{i:02d}".encode(), f"v{round_}".encode())
+    vals = [v for _, v in kv.scan(b"k", b"l")]
+    assert len(vals) == 16 and all(v == b"v4" for v in vals)
+    ssts = [f for f in os.listdir(tmp_path) if f.endswith(".kv")]
+    assert ssts, "memtable_bytes=64 must have flushed"
+    kv.close()
+    kv2 = OrderedKV(str(tmp_path))
+    assert all(v == b"v4" for _, v in kv2.scan(b"k", b"l"))
+    kv2.close()
+
+
+def test_kv_compaction_drops_tombstones_and_filtered(tmp_path):
+    dead: set[bytes] = set()
+    kv = OrderedKV(str(tmp_path), memtable_bytes=64, max_ssts=2,
+                   compaction_filter=lambda k: k in dead)
+    for i in range(20):
+        kv.put(f"k{i:02d}".encode(), b"x" * 8)
+    kv.delete(b"k00")
+    dead.add(b"k01")
+    kv.compact()
+    assert kv.get(b"k00") is None and kv.get(b"k01") is None
+    assert kv.get(b"k02") == b"x" * 8
+    ssts = [f for f in os.listdir(tmp_path) if f.endswith(".kv")]
+    assert len(ssts) == 1, "full merge must leave one table"
+    kv.close()
+
+
+def test_kv_torn_wal_tail_truncated(tmp_path):
+    kv = OrderedKV(str(tmp_path))
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv._wal.close()                    # crash: no clean close, no flush
+    wal = os.path.join(tmp_path, "wal")
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 3)
+    kv2 = OrderedKV(str(tmp_path))
+    assert kv2.get(b"a") == b"1" and kv2.get(b"b") is None
+    kv2.close()
+
+
+def test_kv_mid_wal_corruption_refuses_open(tmp_path):
+    kv = OrderedKV(str(tmp_path))
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv._wal.close()                    # crash: the WAL still holds both
+    wal = os.path.join(tmp_path, "wal")
+    with open(wal, "r+b") as f:
+        f.seek(_WAL_HDR.size + 2)      # payload of the FIRST record
+        b = f.read(1)
+        f.seek(_WAL_HDR.size + 2)
+        f.write(bytes([b[0] ^ 0x10]))
+    with pytest.raises(CorruptKVError):
+        OrderedKV(str(tmp_path))
+
+
+def test_kv_corrupt_sst_refuses_open(tmp_path):
+    kv = OrderedKV(str(tmp_path))
+    kv.put(b"a", b"1" * 64)
+    kv.flush()
+    kv.close()
+    sst = [f for f in os.listdir(tmp_path) if f.endswith(".kv")][0]
+    path = os.path.join(tmp_path, sst)
+    with open(path, "r+b") as f:
+        f.seek(20)
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0x10]))
+    with pytest.raises(CorruptKVError):
+        OrderedKV(str(tmp_path))
+
+
+def test_kv_unpublished_tmp_swept(tmp_path):
+    kv = OrderedKV(str(tmp_path))
+    kv.put(b"a", b"1")
+    kv.close()
+    tmp = os.path.join(tmp_path, "sst-99999999.kv.tmp")
+    with open(tmp, "wb") as f:
+        f.write(b"partial flush never renamed")
+    kv2 = OrderedKV(str(tmp_path))
+    assert not os.path.exists(tmp)
+    assert kv2.get(b"a") == b"1"
+    kv2.close()
+
+
+# ---------------------------------------------------------------------------
+# KVLogDB contract (mirrors tests/test_tan.py)
+# ---------------------------------------------------------------------------
+
+
+def test_save_and_iterate(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    db.save_raft_state([_update(n=5)], worker_id=0)
+    ents = db.iterate_entries(1, 1, 1, 6, 0)
+    assert [e.index for e in ents] == [1, 2, 3, 4, 5]
+    assert ents[2].cmd == b"e3"
+    rs = db.read_raft_state(1, 1, 0)
+    assert rs.state.vote == 2 and rs.first_index == 1 and rs.entry_count == 5
+    db.close()
+
+
+def test_restart_from_disk(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    db.save_bootstrap_info(1, 1, pb.Bootstrap(addresses={1: "a", 2: "b"}))
+    db.save_raft_state([_update(n=4, commit=2)], worker_id=0)
+    db.save_raft_state([_update(term=2, first=5, n=2, commit=4)], worker_id=0)
+    db.close()
+
+    db2 = KVLogDB(str(tmp_path))
+    ents = db2.iterate_entries(1, 1, 1, 7, 0)
+    assert [e.index for e in ents] == [1, 2, 3, 4, 5, 6]
+    assert ents[5].term == 2
+    rs = db2.read_raft_state(1, 1, 0)
+    assert rs.state.term == 2 and rs.state.commit == 4
+    assert db2.get_bootstrap_info(1, 1).addresses == {1: "a", 2: "b"}
+    assert db2.list_node_info() != []
+    db2.close()
+
+
+def test_conflict_overwrite_survives_restart(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    db.save_raft_state([_update(term=1, first=1, n=5)], worker_id=0)
+    # a new-term overwrite of the suffix from index 3: the watermark must
+    # hide the stale 4 and 5 even though their keys still exist
+    db.save_raft_state([_update(term=3, first=3, n=1)], worker_id=0)
+    assert [e.term for e in db.iterate_entries(1, 1, 1, 10, 0)] == [1, 1, 3]
+    assert db.read_raft_state(1, 1, 0).entry_count == 3
+    db.close()
+    db2 = KVLogDB(str(tmp_path))
+    assert [e.term for e in db2.iterate_entries(1, 1, 1, 10, 0)] == [1, 1, 3]
+    # and compaction physically drops them without changing reads
+    db2.kv.compact()
+    assert [e.term for e in db2.iterate_entries(1, 1, 1, 10, 0)] == [1, 1, 3]
+    db2.close()
+
+
+def test_remove_entries_floor_and_compaction(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    for k in range(10):
+        db.save_raft_state([_update(term=1, first=1 + 3 * k, n=3)], 0)
+    db.remove_entries_to(1, 1, 27)
+    assert db.iterate_entries(1, 1, 1, 31, 0) == []
+    assert [e.index for e in db.iterate_entries(1, 1, 28, 31, 0)] == [28, 29, 30]
+    db.compact_entries_to(1, 1, 27)
+    assert [e.index for e in db.iterate_entries(1, 1, 28, 31, 0)] == [28, 29, 30]
+    db.close()
+    db2 = KVLogDB(str(tmp_path))  # floor survives restart
+    assert db2.iterate_entries(1, 1, 1, 31, 0) == []
+    assert [e.index for e in db2.iterate_entries(1, 1, 28, 31, 0)] == [28, 29, 30]
+    db2.close()
+
+
+def test_fsync_called(tmp_path, monkeypatch):
+    calls = []
+    real = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (calls.append(fd), real(fd)))
+    db = KVLogDB(str(tmp_path))
+    db.save_raft_state([_update()], worker_id=0)
+    assert calls, "save_raft_state must fsync"
+    db.close()
+
+
+def test_remove_node_data(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    db.save_raft_state([_update()], worker_id=0)
+    db.save_raft_state([_update(shard=2, replica=1)], worker_id=0)
+    db.remove_node_data(1, 1)
+    assert db.read_raft_state(1, 1, 0) is None
+    assert db.iterate_entries(1, 1, 1, 5, 0) == []
+    assert db.read_raft_state(2, 1, 0) is not None  # neighbor untouched
+    db.close()
+    db2 = KVLogDB(str(tmp_path))
+    assert db2.read_raft_state(1, 1, 0) is None
+    assert db2.read_raft_state(2, 1, 0) is not None
+    db2.close()
+
+
+def test_import_snapshot_restart(tmp_path):
+    db = KVLogDB(str(tmp_path))
+    ss = pb.Snapshot(index=100, term=7, shard_id=1,
+                     membership=pb.Membership(addresses={1: "a", 3: "c"}))
+    db.import_snapshot(ss, 1)
+    db.close()
+    db2 = KVLogDB(str(tmp_path))
+    got = db2.get_snapshot(1, 1)
+    assert got.index == 100 and got.term == 7
+    assert db2.read_raft_state(1, 1, 0).state.commit == 100
+    assert db2.get_bootstrap_info(1, 1).addresses == {1: "a", 3: "c"}
+    db2.close()
+
+
+def test_factory(tmp_path):
+    db = KVLogDBFactory(str(tmp_path)).create()
+    assert db.name() == "kv"
+    db.save_raft_state([_update()], worker_id=0)
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# sharded-kv geometry
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_kv_roundtrip_and_geometry(tmp_path):
+    db = ShardedLogDB(str(tmp_path), num_shards=4, engine="kv")
+    assert db.name() == "sharded-kv-4"
+    for shard in (1, 2, 3, 7):
+        db.save_raft_state([_update(shard=shard, n=3)], worker_id=0)
+    db.close()
+    # engine mismatch on reopen is refused
+    with pytest.raises(ShardGeometryError):
+        ShardedLogDB(str(tmp_path), num_shards=4, engine="tan")
+    db2 = ShardedLogDB(str(tmp_path), num_shards=4, engine="kv")
+    for shard in (1, 2, 3, 7):
+        ents = db2.iterate_entries(shard, 1, 1, 4, 0)
+        assert [e.index for e in ents] == [1, 2, 3]
+    db2.close()
+
+
+def test_sharded_legacy_marker_reads_as_tan(tmp_path):
+    # a pre-engine marker (bare count) must open as tan and refuse kv
+    os.makedirs(tmp_path / "db")
+    with open(tmp_path / "db" / "TANSHARDS", "w") as f:
+        f.write("4\n")
+    db = ShardedLogDB(str(tmp_path / "db"), num_shards=4, engine="tan")
+    db.close()
+    with pytest.raises(ShardGeometryError):
+        ShardedLogDB(str(tmp_path / "db"), num_shards=4, engine="kv")
