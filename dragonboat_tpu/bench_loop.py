@@ -31,14 +31,25 @@ from dragonboat_tpu.core.kstate import (
 from dragonboat_tpu.core.router import route
 
 
-def bench_params(replicas: int = 3) -> KP.KernelParams:
+def bench_params(replicas: int = 3,
+                 platform: str | None = None) -> KP.KernelParams:
     """Measured sweet spot (PERF.md): with the dispatch-by-type inbox
     (family-specialized handler bodies) the fixed scan cost is small
     enough that proposal/replication width 32 is the knee — 1.08M
     writes/s on one CPU core at 1024 groups with this exact config;
     width 48 regresses (bigger ring + conflict scans outweigh the batch
-    gain)."""
+    gain).
+
+    ``platform`` (default: the live backend) picks the ring-read
+    lowering: one-hot selects on device (batched gathers serialize over
+    [G] on TPU), dynamic indexing on CPU (the gather is a plain load
+    there and one-hot costs ~3.5x)."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
     return KP.KernelParams(
+        onehot_reads=(platform != "cpu"),
         num_peers=replicas,
         # 128 comfortably holds the uncompacted window (overhead 16 +
         # apply lag + the in-flight batch ≈ 96) and halves ring traffic

@@ -39,6 +39,16 @@ class KernelParams:
     # buffers), so it is opt-in pending an on-device measurement.
     # Bitwise-identical to the scan either way (differential-tested).
     merge_inbox_families: bool = False
+    # read dynamically-indexed state (the [log_cap] rings, the [P] peer
+    # books, the [RI] read book, the router's [K]/[R] lanes) by one-hot
+    # select instead of dynamic indexing.  On TPU the batched gather
+    # that vmapped indexing lowers to serializes over the [G] axis (r4
+    # ladder: ~0.32 ms/group of linear step cost against a ~10 µs
+    # roofline); the one-hot form is wide VPU passes.  On XLA:CPU the
+    # gather is a real O(1) load and the one-hot form costs 1.4-3.5x
+    # step time (rings worst), so bench_params picks by platform.
+    # Bitwise-identical either way (differential-tested).
+    onehot_reads: bool = True
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

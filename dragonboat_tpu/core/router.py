@@ -75,10 +75,21 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
     second = jnp.min(lane_or_big2, axis=-1)
 
     def pick(src_field, lane):  # src_field [N, Rs, K] ; lane [N, Rt, Rs]
-        sf = jnp.broadcast_to(src_field[:, None], (N, R, R, K))
-        return jnp.take_along_axis(
-            sf, jnp.minimum(lane, K - 1)[..., None], axis=-1
-        )[..., 0]
+        if not kp.onehot_reads:
+            sf = jnp.broadcast_to(src_field[:, None], (N, R, R, K))
+            return jnp.take_along_axis(
+                sf, jnp.minimum(lane, K - 1)[..., None], axis=-1
+            )[..., 0]
+        # one-hot select instead of take_along_axis: a batched gather
+        # serializes over the batch axis on TPU (see kernel._get1); a
+        # lane==K sentinel has no hot slot and reads 0/False, which the
+        # caller's validity mask discards either way (the gather branch
+        # clamps the sentinel to K-1 under the same mask)
+        oh = lane[..., None] == lane_iota                     # [N,Rt,Rs,K]
+        sf = src_field[:, None]                               # [N,1,Rs,K]
+        if src_field.dtype == jnp.bool_:
+            return jnp.any(oh & sf, axis=-1)
+        return jnp.where(oh, sf, 0).sum(axis=-1).astype(src_field.dtype)
 
     resp_valid1 = first < K
     resp_valid2 = second < K
@@ -138,17 +149,27 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
     for q in range(R - 1):
         s_of_t = (t_iota + 1 + q) % R                        # [R]
 
-        def take(x3):  # [N, Rt, Rs...] gather source s_of_t[t]
-            idx = jnp.broadcast_to(
-                s_of_t[None, :, None], (N, R, 1)
-            )
-            return jnp.take_along_axis(x3, idx.reshape(N, R, 1), axis=2)[:, :, 0]
+        # one-hot over the (small, static) source axis — see pick()
+        oh_src = s_of_t[:, None] == jnp.arange(R, dtype=I32)  # [Rt, Rs]
+
+        def take(x3):  # [N, Rt, Rs] select source s_of_t[t]
+            if not kp.onehot_reads:
+                idx = jnp.broadcast_to(s_of_t[None, :, None], (N, R, 1))
+                return jnp.take_along_axis(x3, idx, axis=2)[:, :, 0]
+            oh = oh_src[None]
+            if x3.dtype == jnp.bool_:
+                return jnp.any(oh & x3, axis=2)
+            return jnp.where(oh, x3, 0).sum(axis=2).astype(x3.dtype)
 
         def take4(x4):  # [N, Rt, Rs, E]
-            idx = jnp.broadcast_to(
-                s_of_t[None, :, None, None], (N, R, 1, x4.shape[-1])
-            )
-            return jnp.take_along_axis(x4, idx, axis=2)[:, :, 0]
+            if not kp.onehot_reads:
+                idx = jnp.broadcast_to(
+                    s_of_t[None, :, None, None], (N, R, 1, x4.shape[-1]))
+                return jnp.take_along_axis(x4, idx, axis=2)[:, :, 0]
+            oh = oh_src[None, :, :, None]
+            if x4.dtype == jnp.bool_:
+                return jnp.any(oh & x4, axis=2)
+            return jnp.where(oh, x4, 0).sum(axis=2).astype(x4.dtype)
 
         base = q * 5
         # responses

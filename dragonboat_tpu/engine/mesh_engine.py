@@ -167,6 +167,7 @@ class MeshEngine(KernelEngine):
             members = self._members.get(node.shard_id, {})
             members.pop(node.replica_id, None)
             self.nodes.pop(node.lane, None)
+            self._removed_nodes.append(node)
             self._clear_lane(node.lane)
             self._cut[node.lane] = False
             self._cut_dev = None

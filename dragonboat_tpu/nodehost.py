@@ -107,12 +107,14 @@ class NodeHost:
                     # legacy flat-"tan" dirs migrate in place and get the
                     # flag bumped so a rolled-back binary refuses them
                     # instead of seeing an empty log
-                    self.env.check_node_host_dir("sharded-tan",
-                                                 compatible=("tan",))
+                    engine = nhconfig.expert.logdb.engine
+                    self.env.check_node_host_dir(
+                        f"sharded-{engine}",
+                        compatible=("tan",) if engine == "tan" else ())
                     self.logdb = ShardedLogDB(
                         self.env.logdb_dir,
                         num_shards=nhconfig.expert.logdb.shards,
-                        fs=self.fs)
+                        fs=self.fs, engine=engine)
                 self.id = self.env.node_host_id()
             except Exception:
                 db = getattr(self, "logdb", None)
@@ -363,6 +365,8 @@ class NodeHost:
                                  "device-resident")
 
     def _kernel_params(self, min_inbox: int = 0):
+        import jax
+
         from dragonboat_tpu.core import params as KP
 
         ex = self.config.expert
@@ -375,6 +379,9 @@ class NodeHost:
             readindex_cap=ex.kernel_readindex_cap,
             apply_batch=ex.kernel_apply_batch,
             compaction_overhead=ex.kernel_compaction_overhead,
+            # platform-tuned read lowering (params.py onehot_reads): the
+            # one-hot form wins on device, dynamic indexing wins on CPU
+            onehot_reads=(jax.default_backend() != "cpu"),
         )
 
     def _build_lane_init(self, node, members: dict[int, str]):

@@ -52,7 +52,11 @@ rec = {{
 }}
 # A/B the unrolled inbox families (KernelParams.merge_inbox_families):
 # 28x slower on XLA:CPU, but built for exactly this device's serial
-# launch overhead — the rung records both so the flag decision is data
+# launch overhead — the r4 ladder measured it 44% slower on TPU too
+# (256 groups: 188 vs 130 ms), so the A/B is now opt-in
+if os.environ.get("TPU_GRAB_MERGED") != "1":
+    print("RUNG " + json.dumps(rec))
+    raise SystemExit(0)
 try:
     import dataclasses
     kpm = dataclasses.replace(kp, merge_inbox_families=True)

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Tunnel watcher: probe the TPU backend every ~8 min; on revival, spend
+# the window on the measurement queue (profile first — it answers the
+# current optimization question — then the throughput ladder).  Every
+# result lands in PERF_TPU.jsonl / tpu_profile_*.log, so a window is
+# never wasted even if the tunnel dies mid-run.
+REPO="$(dirname "$(dirname "$(readlink -f "$0")")")"
+LOG="$REPO/tpu_watch.log"
+cd "$REPO" || exit 1
+while true; do
+    if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) tunnel ALIVE; measuring" >> "$LOG"
+        timeout 900 python scripts/tpu_profile.py 1024 \
+            > "$REPO/tpu_profile_$(date -u +%H%M).log" 2>&1
+        timeout 3000 python scripts/tpu_grab.py --ladder 1024,4096,8192 \
+            >> "$LOG" 2>&1
+        echo "$(date -u +%FT%TZ) measurement pass done" >> "$LOG"
+        sleep 1800
+    else
+        echo "$(date -u +%FT%TZ) tunnel wedged" >> "$LOG"
+        sleep 480
+    fi
+done

@@ -609,3 +609,46 @@ def test_diff_merged_families_lockstep(seed):
         for name, va, vb in zip(sa._fields, sa, sb):
             assert np.array_equal(va, vb), \
                 f"phase {phase} field {name} diverged (seed {seed})"
+
+
+@pytest.mark.parametrize("seed", [5, 42])
+def test_diff_onehot_reads_lockstep(seed):
+    """The platform-tuned read lowering (KernelParams.onehot_reads:
+    one-hot select on device, dynamic indexing on CPU — kernel._get1,
+    router pick/take) must stay BITWISE identical across the flag.
+    Same phase plan as the merged-families differential: elect, drop
+    storm, write load, mixed reads — every state leaf compared at each
+    phase end."""
+    import dataclasses
+
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        make_cluster,
+        run_steps,
+        run_steps_mixed,
+        run_steps_storm,
+        elect_all,
+    )
+
+    def drive(kp):
+        state, box = elect_all(kp, 3, make_cluster(kp, 64, 3))
+        snaps = [jax.tree_util.tree_map(np.asarray, state)]
+        state, box = run_steps_storm(kp, 3, 40, 0.25, seed, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box = run_steps(kp, 3, 30, True, True, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box, _ = run_steps_mixed(
+            kp, 3, 20, max(1, kp.proposal_cap // 8),
+            np.int32(7), state, box, np.int32(0))
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        return snaps
+
+    kp = bench_params(3)
+    a = drive(dataclasses.replace(kp, onehot_reads=False))
+    b = drive(dataclasses.replace(kp, onehot_reads=True))
+    for phase, (sa, sb) in enumerate(zip(a, b)):
+        for name, va, vb in zip(sa._fields, sa, sb):
+            assert np.array_equal(va, vb), \
+                f"phase {phase} field {name} diverged (seed {seed})"
