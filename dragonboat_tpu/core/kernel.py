@@ -277,6 +277,16 @@ def _set_row(arr, idx, val, mask):
     return jnp.where(oh[:, None], val, arr)
 
 
+def onehot_select(oh, arr, axis: int):
+    """Reduce ``arr`` along ``axis`` through the one-hot mask ``oh``
+    (broadcastable to arr): the shared lowering behind _get1/_get_row and
+    the router's lane/source selects.  Exact when at most one mask slot
+    is hot (ints sum a single term; bools use any)."""
+    if arr.dtype == jnp.bool_:
+        return jnp.any(oh & arr, axis=axis)
+    return jnp.where(oh, arr, 0).sum(axis=axis).astype(arr.dtype)
+
+
 def _get1(kp: P.KernelParams, arr, idx):
     """Platform-tuned read of one dynamic slot: arr[idx], idx in [0, N).
 
@@ -296,9 +306,7 @@ def _get1(kp: P.KernelParams, arr, idx):
         return arr[idx]
     n = arr.shape[0]
     oh = jnp.expand_dims(idx, -1) == jnp.arange(n, dtype=I32)
-    if arr.dtype == jnp.bool_:
-        return jnp.any(oh & arr, axis=-1)
-    return jnp.where(oh, arr, 0).sum(axis=-1).astype(arr.dtype)
+    return onehot_select(oh, arr, -1)
 
 
 def _get_row(kp: P.KernelParams, arr, idx):
@@ -307,9 +315,7 @@ def _get_row(kp: P.KernelParams, arr, idx):
         return arr[idx]
     n = arr.shape[0]
     oh = jnp.arange(n, dtype=I32) == idx
-    if arr.dtype == jnp.bool_:
-        return jnp.any(oh[:, None] & arr, axis=0)
-    return jnp.where(oh[:, None], arr, 0).sum(axis=0).astype(arr.dtype)
+    return onehot_select(oh[:, None], arr, 0)
 
 
 def _append_one(kp, s: ShardState, mask, term, is_cc,
@@ -603,10 +609,12 @@ def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
     cap = s.lt.shape[0]
     rel = (jnp.arange(cap, dtype=I32) - _slot(kp, m.log_index + 1)) & (cap - 1)
     lane_of_slot = jnp.minimum(rel, E - 1)
-    slot_written = (rel < E) & wmask[lane_of_slot]
+    # [CAP]-shaped reads of the [E] message lanes go through _get1 (the
+    # dynamic-index form is a G*CAP-row batched gather on device)
+    slot_written = (rel < E) & _get1(kp, wmask, lane_of_slot)
     s = s._replace(
-        lt=jnp.where(slot_written, m.ent_term[lane_of_slot], s.lt),
-        lcc=jnp.where(slot_written, m.ent_cc[lane_of_slot], s.lcc),
+        lt=jnp.where(slot_written, _get1(kp, m.ent_term, lane_of_slot), s.lt),
+        lcc=jnp.where(slot_written, _get1(kp, m.ent_cc, lane_of_slot), s.lcc),
     )
     if kp.inline_payloads:
         # trace-time contract: a payload-carrying kernel must be fed
@@ -616,7 +624,8 @@ def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
             raise ValueError(
                 "inline_payloads kernel requires Inbox.ent_val lanes")
         s = s._replace(
-            lv=jnp.where(slot_written, m.ent_val[lane_of_slot], s.lv))
+            lv=jnp.where(slot_written, _get1(kp, m.ent_val, lane_of_slot),
+                         s.lv))
     new_last_if_append = m.log_index + m.n_ent
     s = mrep(s, do_append, last=new_last_if_append,
              stable=jnp.minimum(s.stable, m.log_index + append_from_lane))
@@ -1039,14 +1048,16 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     off = (pos - _slot(kp, base)) & (kp.log_cap - 1)
     in_win = off < n_total
     off_c = jnp.minimum(off, B - 1)
+    # [CAP]-indexed reads of the [B] by-offset tables: _get1 handles the
+    # vector index (one-hot [CAP, B] on device, gather on CPU)
     s = s._replace(
         lt=sel(in_win, jnp.broadcast_to(s.term, pos.shape), s.lt),
-        lcc=sel(in_win, cc_by_off[off_c], s.lcc),
+        lcc=sel(in_win, _get1(kp, cc_by_off, off_c), s.lcc),
         last=s.last + n_total,
         pending_cc=s.pending_cc | jnp.any(do & cc_first),
     )
     if kp.inline_payloads:
-        s = s._replace(lv=sel(in_win, val_by_off[off_c], s.lv))
+        s = s._replace(lv=sel(in_win, _get1(kp, val_by_off, off_c), s.lv))
     eff = eff._replace(save_from=sel(
         appended_any, jnp.minimum(eff.save_from, base), eff.save_from))
     self_mask = _self_slot_mask(s)

@@ -46,9 +46,12 @@ class KernelParams:
     # ladder: ~0.32 ms/group of linear step cost against a ~10 µs
     # roofline); the one-hot form is wide VPU passes.  On XLA:CPU the
     # gather is a real O(1) load and the one-hot form costs 1.4-3.5x
-    # step time (rings worst), so bench_params picks by platform.
-    # Bitwise-identical either way (differential-tested).
-    onehot_reads: bool = True
+    # step time (rings worst).  Default False (the CPU graph — also what
+    # direct constructors in tests get); the real entry points
+    # (bench_loop.bench_params, NodeHost._kernel_params) flip it on
+    # whenever the backend is not cpu.  Bitwise-identical either way
+    # (differential-tested).
+    onehot_reads: bool = False
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.kstate import Inbox, ShardState, StepInput, StepOutput
-from dragonboat_tpu.core.kernel import step
+from dragonboat_tpu.core.kernel import onehot_select, step
 
 MT = pb.MessageType
 I32 = jnp.int32
@@ -86,10 +86,7 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
         # caller's validity mask discards either way (the gather branch
         # clamps the sentinel to K-1 under the same mask)
         oh = lane[..., None] == lane_iota                     # [N,Rt,Rs,K]
-        sf = src_field[:, None]                               # [N,1,Rs,K]
-        if src_field.dtype == jnp.bool_:
-            return jnp.any(oh & sf, axis=-1)
-        return jnp.where(oh, sf, 0).sum(axis=-1).astype(src_field.dtype)
+        return onehot_select(oh, src_field[:, None], -1)
 
     resp_valid1 = first < K
     resp_valid2 = second < K
@@ -156,20 +153,14 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
             if not kp.onehot_reads:
                 idx = jnp.broadcast_to(s_of_t[None, :, None], (N, R, 1))
                 return jnp.take_along_axis(x3, idx, axis=2)[:, :, 0]
-            oh = oh_src[None]
-            if x3.dtype == jnp.bool_:
-                return jnp.any(oh & x3, axis=2)
-            return jnp.where(oh, x3, 0).sum(axis=2).astype(x3.dtype)
+            return onehot_select(oh_src[None], x3, 2)
 
         def take4(x4):  # [N, Rt, Rs, E]
             if not kp.onehot_reads:
                 idx = jnp.broadcast_to(
                     s_of_t[None, :, None, None], (N, R, 1, x4.shape[-1]))
                 return jnp.take_along_axis(x4, idx, axis=2)[:, :, 0]
-            oh = oh_src[None, :, :, None]
-            if x4.dtype == jnp.bool_:
-                return jnp.any(oh & x4, axis=2)
-            return jnp.where(oh, x4, 0).sum(axis=2).astype(x4.dtype)
+            return onehot_select(oh_src[None, :, :, None], x4, 2)
 
         base = q * 5
         # responses

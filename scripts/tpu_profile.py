@@ -87,10 +87,14 @@ def main() -> None:
     print("  top ops: " + ", ".join(f"{k}={v}" for k, v in top))
 
     if "--hlo-dump" in sys.argv:
-        path = sys.argv[sys.argv.index("--hlo-dump") + 1]
-        with open(path, "w") as f:
-            f.write(hlo)
-        print(f"dumped HLO to {path}")
+        i = sys.argv.index("--hlo-dump") + 1
+        if i >= len(sys.argv):
+            print("--hlo-dump needs a filename; skipping dump")
+        else:
+            path = sys.argv[i]
+            with open(path, "w") as f:
+                f.write(hlo)
+            print(f"dumped HLO to {path}")
 
     # measured time via the jitted entry (same executable via cache)
     out = run_steps(kp, 3, 20, True, True, state, box)

@@ -11,7 +11,7 @@ while true; do
     if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
         echo "$(date -u +%FT%TZ) tunnel ALIVE; measuring" >> "$LOG"
         timeout 900 python scripts/tpu_profile.py 1024 \
-            > "$REPO/tpu_profile_$(date -u +%H%M).log" 2>&1
+            > "$REPO/tpu_profile_$(date -u +%F_%H%M).log" 2>&1
         timeout 3000 python scripts/tpu_grab.py --ladder 1024,4096,8192 \
             >> "$LOG" 2>&1
         echo "$(date -u +%FT%TZ) measurement pass done" >> "$LOG"
