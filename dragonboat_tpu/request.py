@@ -110,13 +110,31 @@ class _ClockedBook:
 
 
 class PendingProposal(_ClockedBook):
-    """Proposal completion book keyed by entry Key (request.go:524/1016)."""
+    """Proposal completion book keyed by entry Key (request.go:524/1016).
+
+    Sharded by ``key % shards`` the way the reference splits its book
+    into keyed shards (request.go:524 pendingProposal holds N
+    proposalShards) so concurrent client threads completing/registering
+    different keys never serialize on one lock — the engine's apply
+    path touches a different shard than the ingress path almost always.
+    The logical clock stays book-wide (ticks are engine-driven)."""
 
     _seq = itertools.count(1)
 
-    def __init__(self) -> None:
+    def __init__(self, shards: int = 8) -> None:
         super().__init__()
-        self.pending: dict[int, RequestState] = {}
+        self._shards: list[dict[int, RequestState]] = [
+            {} for _ in range(shards)]
+        self._locks = [threading.Lock() for _ in range(shards)]
+        self._n = shards
+
+    @property
+    def pending(self) -> dict[int, RequestState]:
+        """Merged read-only view (tests/diagnostics)."""
+        out: dict[int, RequestState] = {}
+        for d in self._shards:
+            out.update(d)
+        return out
 
     def propose(self, session, cmd: bytes, timeout_ticks: int
                 ) -> tuple[RequestState, pb.Entry]:
@@ -129,44 +147,52 @@ class PendingProposal(_ClockedBook):
             cmd=cmd,
         )
         rs = RequestState(key=key, deadline_tick=self.tick + timeout_ticks)
-        with self.mu:
-            self.pending[key] = rs
+        i = key % self._n
+        with self._locks[i]:
+            self._shards[i][key] = rs
         return rs, entry
 
     def applied(self, key: int, client_id: int, series_id: int,
                 result: Result, rejected: bool) -> None:
-        with self.mu:
-            rs = self.pending.pop(key, None)
+        i = key % self._n
+        with self._locks[i]:
+            rs = self._shards[i].pop(key, None)
         if rs is not None:
             code = (RequestResultCode.REJECTED if rejected
                     else RequestResultCode.COMPLETED)
             rs.notify(RequestResult(code=code, result=result))
 
     def committed(self, key: int) -> None:
-        with self.mu:
-            rs = self.pending.get(key)
+        i = key % self._n
+        with self._locks[i]:
+            rs = self._shards[i].get(key)
         if rs is not None:
             rs.notify_committed()
 
     def dropped(self, key: int) -> None:
-        with self.mu:
-            rs = self.pending.pop(key, None)
+        i = key % self._n
+        with self._locks[i]:
+            rs = self._shards[i].pop(key, None)
         if rs is not None:
             rs.notify(RequestResult(code=RequestResultCode.DROPPED))
 
     def gc(self) -> None:
-        with self.mu:
-            expired = [k for k, rs in self.pending.items()
-                       if rs.deadline_tick <= self.tick]
-            for k in expired:
-                self.pending.pop(k).notify(
-                    RequestResult(code=RequestResultCode.TIMEOUT))
+        for i in range(self._n):
+            with self._locks[i]:
+                d = self._shards[i]
+                expired = [k for k, rs in d.items()
+                           if rs.deadline_tick <= self.tick]
+                fired = [d.pop(k) for k in expired]
+            for rs in fired:
+                rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
 
     def terminate_all(self) -> None:
-        with self.mu:
-            for rs in self.pending.values():
+        for i in range(self._n):
+            with self._locks[i]:
+                fired = list(self._shards[i].values())
+                self._shards[i].clear()
+            for rs in fired:
                 rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
-            self.pending.clear()
 
 
 class PendingReadIndex(_ClockedBook):

@@ -29,6 +29,9 @@ def measure(groups, cap=256, k=None, e=16, b=16, steps=20, replicas=3):
         num_peers=replicas, log_cap=cap, inbox_cap=k, msg_entries=e,
         proposal_cap=b, readindex_cap=4, apply_batch=2 * b,
         compaction_overhead=2 * b,
+        # same platform pick as bench_params — a device sweep must
+        # measure the one-hot graph, not the deprecated gather one
+        onehot_reads=(jax.default_backend() != "cpu"),
     )
     state = make_cluster(kp, groups, replicas)
     t0 = time.time()

@@ -12,8 +12,15 @@ while true; do
         echo "$(date -u +%FT%TZ) tunnel ALIVE; measuring" >> "$LOG"
         timeout 900 python scripts/tpu_profile.py 1024 \
             > "$REPO/tpu_profile_$(date -u +%F_%H%M).log" 2>&1
-        timeout 3000 python scripts/tpu_grab.py --ladder 1024,4096,8192 \
+        # small rung first pins the fixed-cost intercept of the new
+        # kernel; big rungs amortize it
+        timeout 3000 python scripts/tpu_grab.py --ladder 64,1024,4096,8192 \
             >> "$LOG" 2>&1
+        # the scoreboard itself: a full bench on device (provisional
+        # lines survive a mid-run wedge)
+        timeout 3000 python "$REPO/bench.py" \
+            > "$REPO/bench_tpu_$(date -u +%F_%H%M).json" \
+            2>> "$LOG"
         echo "$(date -u +%FT%TZ) measurement pass done" >> "$LOG"
         sleep 1800
     else

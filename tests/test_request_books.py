@@ -1,0 +1,85 @@
+"""Direct unit coverage for the sharded proposal book (request.py —
+parity request.go:524 pendingProposal's keyed shards): registration,
+completion, commit notification, timeout GC and termination must behave
+identically across shard boundaries."""
+
+import threading
+
+from dragonboat_tpu.request import PendingProposal, RequestResultCode
+from dragonboat_tpu.client import Session
+from dragonboat_tpu.statemachine import Result
+
+
+def _book(shards=4):
+    return PendingProposal(shards=shards)
+
+
+def _noop_session():
+    return Session.new_noop_session(1)
+
+
+def test_propose_applied_across_shards():
+    book = _book()
+    states = []
+    for i in range(16):  # keys cover every shard several times
+        rs, entry = book.propose(_noop_session(), b"x", 100)
+        states.append((rs, entry))
+    for rs, entry in states:
+        book.applied(entry.key, 0, 0, Result(value=entry.key), False)
+    for rs, entry in states:
+        assert rs.wait(1.0).code == RequestResultCode.COMPLETED
+        assert rs.wait(1.0).result.value == entry.key
+
+
+def test_committed_then_applied_fires_both():
+    book = _book()
+    rs, entry = book.propose(_noop_session(), b"x", 100)
+    book.committed(entry.key)
+    assert rs.committed_event.wait(1.0)
+    book.applied(entry.key, 0, 0, Result(), False)
+    assert rs.wait(1.0).code == RequestResultCode.COMPLETED
+
+
+def test_gc_times_out_only_expired():
+    book = _book()
+    rs_short, e_short = book.propose(_noop_session(), b"x", 2)
+    rs_long, e_long = book.propose(_noop_session(), b"x", 100)
+    for _ in range(3):
+        book.advance()
+    book.gc()
+    assert rs_short.wait(1.0).code == RequestResultCode.TIMEOUT
+    assert not rs_long._event.is_set()
+    book.applied(e_long.key, 0, 0, Result(), False)
+    assert rs_long.wait(1.0).code == RequestResultCode.COMPLETED
+
+
+def test_terminate_all_covers_every_shard():
+    book = _book()
+    states = [book.propose(_noop_session(), b"x", 100)[0] for _ in range(9)]
+    book.terminate_all()
+    assert all(rs.wait(1.0).code == RequestResultCode.TERMINATED
+               for rs in states)
+    assert book.pending == {}
+
+
+def test_concurrent_propose_complete():
+    book = _book()
+    done = []
+    mu = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            rs, entry = book.propose(_noop_session(), b"x", 100)
+            book.applied(entry.key, 0, 0, Result(value=entry.key), False)
+            r = rs.wait(1.0)
+            with mu:
+                done.append(r.code)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(done) == 200
+    assert all(c == RequestResultCode.COMPLETED for c in done)
+    assert book.pending == {}
