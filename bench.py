@@ -27,6 +27,9 @@ fallback — one core crunches the batch serially, so scale only slows the
 same measurement), BENCH_STEPS (default 200), BENCH_CHUNK (device-launch
 chunking under the ~60 s watchdog), BENCH_PROBE_TIMEOUT (default 180 s),
 BENCH_FORCE_CPU=1, BENCH_LAT_STEPS / BENCH_MIXED_STEPS (phase lengths),
+BENCH_MIXED_WRITE_WIDTH (phase B write lanes; default full batch width —
+the 9:1 ratio rides the per-ctx read batch, capped at 9 reads per
+committed write),
 BENCH_STORM=0 (skip phase C), BENCH_STORM_GROUPS / BENCH_STORM_STEPS /
 BENCH_STORM_DROP (storm shape), BENCH_DEVICE_SM=1 (full data path:
 committed writes applied to the device-resident KV state machine by the
@@ -381,7 +384,14 @@ def _measure(platform: str, groups: int, steps: int) -> None:
 
         mixed_steps = int(os.environ.get(
             "BENCH_MIXED_STEPS", str(max(40, steps // 2))))
-        WW = max(1, B // 8)          # narrow writes; reads dominate
+        # writes keep the full batch width: the 9:1 ratio is carried by
+        # the read batch behind each ReadIndex ctx (raft.go ReadIndex
+        # batching serves every read queued at confirmation time), and
+        # ctx confirmation throughput (~1/group/step, one piggybacked
+        # heartbeat round) is independent of the write width — narrowing
+        # writes only shrank both terms of the mix
+        WW = max(1, min(B, int(os.environ.get("BENCH_MIXED_WRITE_WIDTH",
+                                              str(B)))))
 
         def mixed_run(iters):
             nonlocal state, box, reads, now

@@ -964,11 +964,16 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
             s_, eff_, r, _ = _process_family(kp, _fam, s_, eff_, m)
             return (s_, eff_), tuple(r)
 
-        # DO NOT unroll this scan ('rep'/'any' — the replicate body's
-        # masked ring rewrites would materialize a fresh [G, log_cap]
-        # copy per slot; measured 11x slower on XLA:CPU, 2026-07-30).
-        # Rolled, XLA aliases the carry and the updates run in place.
-        (s, eff), part = jax.lax.scan(_scan_msg, (s, eff), sub)
+        # Rolled by default ('rep'/'any' — unrolling materializes a fresh
+        # [G, log_cap] ring copy per slot; measured 11x slower on
+        # XLA:CPU, 2026-07-30, where the rolled carry aliases in place).
+        # kp.unroll_scans flips it for the device A/B: on TPU each scan
+        # iteration is a separate serial launch of the whole body, and
+        # lax.scan's unroll flag is bitwise-neutral (unlike the
+        # restructured merge_inbox_families path).
+        (s, eff), part = jax.lax.scan(
+            _scan_msg, (s, eff), sub,
+            unroll=len(idxs) if kp.unroll_scans else 1)
         r_parts.append(part)
     r_stack = tuple(
         jnp.concatenate([p[i] for p in r_parts], axis=0)

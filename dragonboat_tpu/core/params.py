@@ -52,6 +52,12 @@ class KernelParams:
     # whenever the backend is not cpu.  Bitwise-identical either way
     # (differential-tested).
     onehot_reads: bool = False
+    # unroll the per-family inbox scans (lax.scan unroll flag — bitwise
+    # neutral, pure scheduling).  Off everywhere by default: XLA:CPU
+    # measured 11x slower unrolled (the rolled carry aliases in place).
+    # Exists for the TPU A/B, where each rolled iteration is its own
+    # serial launch of the full family body.
+    unroll_scans: bool = False
 
     def __post_init__(self) -> None:
         assert self.log_cap & (self.log_cap - 1) == 0, "log_cap must be 2^n"

@@ -24,14 +24,18 @@ from dragonboat_tpu.core import params as KP
 
 
 def measure(groups, cap=256, k=None, e=16, b=16, steps=20, replicas=3):
+    import dataclasses
+
+    from dragonboat_tpu.bench_loop import bench_params
+
     k = k if k is not None else 5 * (replicas - 1)
-    kp = KP.KernelParams(
-        num_peers=replicas, log_cap=cap, inbox_cap=k, msg_entries=e,
-        proposal_cap=b, readindex_cap=4, apply_batch=2 * b,
-        compaction_overhead=2 * b,
-        # same platform pick as bench_params — a device sweep must
-        # measure the one-hot graph, not the deprecated gather one
-        onehot_reads=(jax.default_backend() != "cpu"),
+    # geometry overrides on top of bench_params so the sweep inherits
+    # every platform-picked lowering flag (onehot_reads today, whatever
+    # comes next) instead of hand-copying the pick
+    kp = dataclasses.replace(
+        bench_params(replicas),
+        log_cap=cap, inbox_cap=k, msg_entries=e, proposal_cap=b,
+        readindex_cap=4, apply_batch=2 * b, compaction_overhead=2 * b,
     )
     state = make_cluster(kp, groups, replicas)
     t0 = time.time()

@@ -50,25 +50,29 @@ rec = {{
     "setup_s": round(setup_s, 1), "compile_s": round(compile_s, 1),
     "step_ms": round(dt / N * 1000, 3), "writes_per_s": int(wps),
 }}
-# A/B the unrolled inbox families (KernelParams.merge_inbox_families):
-# 28x slower on XLA:CPU, but built for exactly this device's serial
-# launch overhead — the r4 ladder measured it 44% slower on TPU too
-# (256 groups: 188 vs 130 ms), so the A/B is now opt-in
-if os.environ.get("TPU_GRAB_MERGED") != "1":
-    print("RUNG " + json.dumps(rec))
-    raise SystemExit(0)
+# Second measurement per rung: A/B one variant against the plain kernel.
+# Default is unroll_scans (lax.scan unroll — bitwise-neutral scheduling,
+# kills the per-iteration serial launches of the family scans);
+# TPU_GRAB_MERGED=1 measures the old merge_inbox_families restructure
+# instead (44% slower on TPU at r4, kept for re-checks).
+variant = ("merge_inbox_families" if os.environ.get("TPU_GRAB_MERGED") == "1"
+           else "unroll_scans")
+# bank the plain measurement NOW: the variant costs a second compile,
+# and a wedge/timeout there must not lose the rung (the harvester takes
+# the LAST RUNG line)
+print("RUNG " + json.dumps(rec), flush=True)
 try:
     import dataclasses
-    kpm = dataclasses.replace(kp, merge_inbox_families=True)
+    kpm = dataclasses.replace(kp, **{{variant: True}})
     state2, box2 = elect_all(kpm, 3, make_cluster(kpm, G, 3))
     state2, box2 = run_steps(kpm, 3, 4, True, True, state2, box2)
     jax.block_until_ready(state2.term)
     t0 = time.time()
     state2, box2 = run_steps(kpm, 3, N, True, True, state2, box2)
     jax.block_until_ready(state2.term)
-    rec["merged_step_ms"] = round((time.time() - t0) / N * 1000, 3)
-except Exception as e:   # the plain rung must survive a merged failure
-    rec["merged_error"] = str(e)[-200:]
+    rec[variant + "_step_ms"] = round((time.time() - t0) / N * 1000, 3)
+except Exception as e:   # the plain rung must survive a variant failure
+    rec[variant + "_error"] = str(e)[-200:]
 print("RUNG " + json.dumps(rec))
 """
 
@@ -105,19 +109,28 @@ def main() -> None:
         try:
             r = subprocess.run([sys.executable, "-c", code], env=env,
                                capture_output=True, text=True, timeout=900)
-        except subprocess.TimeoutExpired:
-            rec = {"ts": time.time(), "groups": g, "error": "rung timeout"}
-            with open(OUT, "a") as f:
-                f.write(json.dumps(rec) + "\n")
-            print(json.dumps(rec), flush=True)
-            break
-        line = next((ln for ln in r.stdout.splitlines()
-                     if ln.startswith("RUNG ")), None)
-        if line is None:
+            out = r.stdout or ""
+            err = r.stderr or ""
+        except subprocess.TimeoutExpired as e:
+            # salvage a banked plain measurement from the partial output
+            out = (e.stdout or b"")
+            out = out.decode(errors="replace") if isinstance(out, bytes) else out
+            err = "rung timeout"
+            r = None
+        rec_parsed = None
+        for ln in out.splitlines():   # last PARSEABLE RUNG line wins (a
+            if ln.startswith("RUNG "):  # kill mid-write truncates the tail)
+                try:
+                    rec_parsed = json.loads(ln[5:])
+                except ValueError:
+                    pass
+        if rec_parsed is None:
             rec = {"ts": time.time(), "groups": g,
-                   "error": (r.stderr or "no output")[-500:]}
+                   "error": (err or "no output")[-500:]}
         else:
-            rec = json.loads(line[5:])
+            rec = rec_parsed
+            if r is None:   # plain banked, variant lost to the timeout
+                rec["variant_timeout"] = True
         with open(OUT, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)

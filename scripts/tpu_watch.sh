@@ -14,7 +14,7 @@ while true; do
             > "$REPO/tpu_profile_$(date -u +%F_%H%M).log" 2>&1
         # small rung first pins the fixed-cost intercept of the new
         # kernel; big rungs amortize it
-        timeout 3000 python scripts/tpu_grab.py --ladder 64,1024,4096,8192 \
+        timeout 3700 python scripts/tpu_grab.py --ladder 64,1024,4096,8192 \
             >> "$LOG" 2>&1
         # the scoreboard itself: a full bench on device (provisional
         # lines survive a mid-run wedge)
