@@ -41,6 +41,15 @@ class CorruptKVError(Exception):
     """A non-tail record failed its checksum — the store is damaged."""
 
 
+class FlushError(Exception):
+    """A memtable flush (or its compaction) failed AFTER the triggering
+    batch was durably appended to the WAL and applied to the memtable.
+    The batch itself is safe — recovery replays it — but the store is
+    degraded (the flush retries on the next write/close).  Callers that
+    stage side effects on write success must treat this as success for
+    the batch and failure for the engine."""
+
+
 class _SSTable:
     """One immutable sorted table: in-memory key index, values on disk."""
 
@@ -228,7 +237,17 @@ class OrderedKV:
             for k in dels:
                 self._mem_put(k, _TOMB)
             if self._mem_size >= self.memtable_bytes:
-                self._flush_locked()
+                if not sync:
+                    # no durability claim to scope: an unsynced batch is
+                    # best-effort either way, so flush errors propagate raw
+                    self._flush_locked()
+                else:
+                    try:
+                        self._flush_locked()
+                    except Exception as e:   # KeyboardInterrupt/SystemExit
+                        raise FlushError(    # must propagate unchanged
+                            "flush failed after the batch was made durable"
+                        ) from e
 
     def put(self, key: bytes, val: bytes, sync: bool = True) -> None:
         self.write_batch([(key, val)], sync=sync)

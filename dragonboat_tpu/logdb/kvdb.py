@@ -28,7 +28,7 @@ import threading
 from typing import Sequence
 
 from dragonboat_tpu import raftpb as pb
-from dragonboat_tpu.logdb.kv import OrderedKV
+from dragonboat_tpu.logdb.kv import FlushError, OrderedKV
 from dragonboat_tpu.raftio import ILogDB, NodeInfo, RaftState
 
 # key prefixes — big-endian fields keep lexicographic == numeric order
@@ -130,11 +130,28 @@ class KVLogDB(ILogDB):
                     # are dead even if their keys still exist
                     marks[key] = ud.entries_to_save[-1].index
                     puts.append((_nk(_K_MAXINDEX, *key), _u64(marks[key])))
-            self.kv.write_batch(puts, sync=True)
-            # the in-memory watermark moves only once the batch is durable:
-            # a failed write must leave reads (and the compaction filter)
-            # agreeing with what is actually on disk
+            # the new watermark must be visible BEFORE the write: the
+            # write itself may trigger a memtable flush + compaction, and
+            # the compaction filter would otherwise drop this very
+            # batch's entries as above-watermark stale keys (a compaction
+            # can only fire after the WAL append+fsync succeeded, so the
+            # batch is durable by the time the filter consults the mark).
+            # A write that never reached the WAL rolls the memory view
+            # back to match disk; a FlushError means the batch itself IS
+            # durable (WAL fsync preceded the flush), so the marks stand.
+            prev = {k: self._maxidx.get(k) for k in marks}
             self._maxidx.update(marks)
+            try:
+                self.kv.write_batch(puts, sync=True)
+            except FlushError:
+                raise
+            except BaseException:
+                for k, v in prev.items():
+                    if v is None:
+                        self._maxidx.pop(k, None)
+                    else:
+                        self._maxidx[k] = v
+                raise
 
     def iterate_entries(self, shard_id, replica_id, low, high, max_size):
         key = (shard_id, replica_id)
@@ -180,8 +197,17 @@ class KVLogDB(ILogDB):
         with self._mu:
             if index <= self._floors.get(key, 0):
                 return
+            # floor moves only after the key is durable: a failed put
+            # must not leave reads (or a later compaction) ahead of disk
+            # — unlike the save-path watermark, nothing in this write
+            # depends on the new floor being visible mid-flush.  A
+            # FlushError means the put itself landed, so the floor moves.
+            try:
+                self.kv.put(_nk(_K_FLOOR, *key), _u64(index))
+            except FlushError:
+                self._floors[key] = index
+                raise
             self._floors[key] = index
-            self.kv.put(_nk(_K_FLOOR, *key), _u64(index))
 
     def compact_entries_to(self, shard_id, replica_id, index):
         self.remove_entries_to(shard_id, replica_id, index)
@@ -213,7 +239,15 @@ class KVLogDB(ILogDB):
                      _K_FLOOR)]
             dels += [k for k, _ in self.kv.scan(_ek(*key, 0),
                                                 _ek(*key, (1 << 64) - 1))]
-            self.kv.write_batch([], dels, sync=True)
+            try:
+                self.kv.write_batch([], dels, sync=True)
+            except FlushError:
+                # the deletion batch IS durable — the in-memory books
+                # must drop with it or a re-added node would inherit a
+                # stale floor/watermark over fresh entries
+                self._floors.pop(key, None)
+                self._maxidx.pop(key, None)
+                raise
             self._floors.pop(key, None)
             self._maxidx.pop(key, None)
 

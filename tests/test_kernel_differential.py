@@ -17,6 +17,8 @@ here against the kernel with pycore as the oracle.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pytest
 
@@ -648,6 +650,61 @@ def test_diff_onehot_reads_lockstep(seed):
     kp = bench_params(3)
     a = drive(dataclasses.replace(kp, onehot_reads=False))
     b = drive(dataclasses.replace(kp, onehot_reads=True))
+    for phase, (sa, sb) in enumerate(zip(a, b)):
+        for name, va, vb in zip(sa._fields, sa, sb):
+            assert np.array_equal(va, vb), \
+                f"phase {phase} field {name} diverged (seed {seed})"
+
+
+@pytest.mark.skipif(os.environ.get("DBT_SLOW_DIFF") != "1",
+                    reason="XLA:CPU compile of the unrolled body exceeded "
+                           "50 CPU-minutes at toy geometry on the 1-core "
+                           "box (2026-07-31); DBT_SLOW_DIFF=1 runs it")
+@pytest.mark.parametrize("seed", [9])
+def test_diff_unroll_scans_lockstep(seed):
+    """lax.scan unroll for the family scans (KernelParams.unroll_scans —
+    the TPU serial-launch lever the ladder A/Bs) must stay BITWISE
+    identical to the rolled form.  Unlike merge_inbox_families (a hand
+    restructure), unroll= is lax.scan's own scheduling parameter with a
+    library-level equivalence contract; this test exists to catch an XLA
+    unroll miscompile, not a semantics change.  Env-gated: the unrolled
+    XLA:CPU compile is pathologically slow (see skip reason) — run it
+    deliberately on a box with headroom, or on TPU where compile is
+    tractable, before trusting a ladder A/B that favors the unrolled
+    form."""
+    import dataclasses
+
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        make_cluster,
+        run_steps,
+        run_steps_mixed,
+        run_steps_storm,
+        elect_all,
+    )
+    from dragonboat_tpu.core import params as KP
+
+    base = KP.KernelParams(
+        num_peers=3, log_cap=32, inbox_cap=10, msg_entries=4,
+        proposal_cap=4, readindex_cap=4, apply_batch=8,
+        compaction_overhead=4,
+    )
+
+    def drive(kp):
+        state, box = elect_all(kp, 3, make_cluster(kp, 16, 3))
+        snaps = [jax.tree_util.tree_map(np.asarray, state)]
+        state, box = run_steps_storm(kp, 3, 30, 0.25, seed, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box = run_steps(kp, 3, 20, True, True, state, box)
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        state, box, _ = run_steps_mixed(
+            kp, 3, 10, 1, np.int32(7), state, box, np.int32(0))
+        snaps.append(jax.tree_util.tree_map(np.asarray, state))
+        return snaps
+
+    a = drive(base)
+    b = drive(dataclasses.replace(base, unroll_scans=True))
     for phase, (sa, sb) in enumerate(zip(a, b)):
         for name, va, vb in zip(sa._fields, sa, sb):
             assert np.array_equal(va, vb), \

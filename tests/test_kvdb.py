@@ -278,3 +278,103 @@ def test_sharded_legacy_marker_reads_as_tan(tmp_path):
     db.close()
     with pytest.raises(ShardGeometryError):
         ShardedLogDB(str(tmp_path / "db"), num_shards=4, engine="kv")
+
+
+# ---------------------------------------------------------------------------
+# power-loss (MemFS.crash) and fault injection (ErrorFS) — the same
+# storage-fault coverage the tan engine carries in tests/test_vfs.py
+# ---------------------------------------------------------------------------
+
+
+from dragonboat_tpu.vfs import ErrorFS, InjectedError, MemFS  # noqa: E402
+
+
+def test_kvdb_on_memfs_crash_keeps_synced_state(tmp_path):
+    fs = MemFS()
+    db = KVLogDB(str(tmp_path), fs=fs)
+    for k in range(1, 11):
+        db.save_raft_state([_update(first=3 * k - 2, n=3, commit=3 * k)], 0)
+    # an unsynced write vanishes at power loss and must not be visible
+    db.kv.put(b"\x7funsynced", b"x", sync=False)
+    fs.crash()
+
+    db2 = KVLogDB(str(tmp_path), fs=fs)
+    ents = db2.iterate_entries(1, 1, 1, 31, 0)
+    assert [e.index for e in ents] == list(range(1, 31))
+    assert db2.read_raft_state(1, 1, 0).state.commit == 30
+    assert db2.kv.get(b"\x7funsynced") is None
+    db2.close()
+
+
+def test_kvdb_memfs_crash_after_flush_keeps_sst_data(tmp_path):
+    fs = MemFS()
+    db = KVLogDB(str(tmp_path), fs=fs, memtable_bytes=256)  # force flushes
+    for k in range(1, 21):
+        db.save_raft_state([_update(first=3 * k - 2, n=3)], 0)
+    db.kv.flush()
+    fs.crash()  # WAL is empty now; everything must come from SSTs
+
+    db2 = KVLogDB(str(tmp_path), fs=fs)
+    ents = db2.iterate_entries(1, 1, 1, 61, 0)
+    assert [e.index for e in ents] == list(range(1, 61))
+    db2.close()
+
+
+def test_kvdb_errorfs_injects_on_fsync(tmp_path):
+    fs = ErrorFS.on_op(MemFS(), "fsync")
+    db = KVLogDB(str(tmp_path), fs=fs)
+    with pytest.raises(InjectedError):
+        db.save_raft_state([_update()], worker_id=0)
+
+
+def test_kvdb_survives_injected_write_failure(tmp_path):
+    base = MemFS()
+    fs = ErrorFS(base)
+    db = KVLogDB(str(tmp_path), fs=fs)
+    for k in range(1, 6):
+        db.save_raft_state([_update(first=3 * k - 2, n=3)], 0)
+    armed = {"on": False}
+    fs.inject = lambda op, path, a=armed: a["on"] and op in ("write", "fsync")
+    armed["on"] = True
+    with pytest.raises(InjectedError):
+        db.save_raft_state([_update(first=16, n=3)], worker_id=0)
+    armed["on"] = False
+    # power loss on top of the fault: acked state only
+    base.crash()
+    db2 = KVLogDB(str(tmp_path), fs=base)
+    ents = db2.iterate_entries(1, 1, 1, 100, 0)
+    assert [e.index for e in ents] == list(range(1, 16))
+    db2.close()
+
+
+def test_kvdb_flush_failure_after_durable_batch(tmp_path):
+    """A flush/compaction failure AFTER the WAL fsync must not roll the
+    watermark back: the batch is durable, and a rolled-back watermark
+    would make a later compaction drop the batch's own entries while
+    the MAXINDEX point key survives (review r4 finding)."""
+    from dragonboat_tpu.logdb.kv import FlushError
+
+    base = MemFS()
+    fs = ErrorFS(base)
+    # tiny memtable: the failing save triggers a flush
+    db = KVLogDB(str(tmp_path), fs=fs, memtable_bytes=512)
+    db.save_raft_state([_update(first=1, n=3)], 0)
+    # fail only SST writes — the WAL path stays healthy
+    fs.inject = lambda op, path: ("sst-" in path
+                                  and op in ("open", "write", "fsync"))
+    with pytest.raises(FlushError):
+        for k in range(2, 30):
+            db.save_raft_state([_update(first=3 * k - 2, n=3)], 0)
+    fs.inject = lambda op, path: False
+    hi = max(db._maxidx.values())
+    # every batch up to the recorded watermark is readable (memtable +
+    # WAL hold them; the failed flush lost nothing)
+    ents = db.iterate_entries(1, 1, 1, hi + 1, 0)
+    assert [e.index for e in ents] == list(range(1, hi + 1))
+    # power loss: WAL replay alone must reproduce the same state
+    base.crash()
+    db2 = KVLogDB(str(tmp_path), fs=base)
+    ents = db2.iterate_entries(1, 1, 1, hi + 1, 0)
+    assert [e.index for e in ents] == list(range(1, hi + 1))
+    assert db2._maxidx[(1, 1)] == hi
+    db2.close()
