@@ -30,6 +30,8 @@ from dragonboat_tpu.core.kstate import (
 )
 from dragonboat_tpu.core.router import route
 
+I32 = jnp.int32
+
 
 def bench_params(replicas: int = 3,
                  platform: str | None = None) -> KP.KernelParams:
@@ -253,6 +255,60 @@ def full_step_sm(kp: KP.KernelParams, replicas: int, kv, state: ShardState,
     # the bench reports the count
     n_rejected = jnp.sum(~ok & valid)
     return state, box2, kv_state, n_rejected, out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def run_steps_mixed_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
+                       write_width: int, now0, state: ShardState, box: Inbox,
+                       kv_state, reads, acc, rejects):
+    """The 9:1 mix with reads SERVED, not just permitted: the device-SM
+    write pipeline (lv ring -> range apply) plus one batched ReadIndex
+    ctx per leader per step, and for every confirmed ctx a window of
+    ``9 * write_width`` lookups below the ctx index is executed against
+    the device-resident table.  ``reads`` counts served CTXs — multiply
+    by RB host-side: an on-device running sum of lookups would overflow
+    int32 within one window at 100k groups.  ``acc`` folds the read
+    VALUES into the carry so the lookups are live computation XLA cannot
+    elide; ``rejects`` accumulates across calls like the other carries.  The read pass is slot-scan shaped ([G, T] compare/select —
+    each table slot tests whether it falls in the served window) rather
+    than a batched gather, for the same reason as kernel._get1.
+    Direct-mapped tables only (key == slot is what makes the slot scan
+    exact)."""
+    assert kp.inline_payloads, "device-SM path needs sm_params()"
+    assert not kv.hash_keys, "served-read slot scan needs direct mapping"
+    T = kv.table_cap
+    CAP, AB = kp.log_cap, kp.apply_batch
+    RB = 9 * write_width
+
+    def body(i, carry):
+        st, bx, ks, rd, ac, rej = carry
+        inp = _self_input(kp, st, True, True, write_width, True, now0 + i)
+        st, out = step(kp, st, bx, inp)
+        bx = route(kp, replicas, out)
+        # write side: released window -> device table (range apply, as
+        # full_step_sm; the take_along_axis window read is shared with
+        # that path and rides its device A/B)
+        idx = out.apply_first[:, None] + jnp.arange(AB, dtype=I32)[None, :]
+        valid = idx <= out.apply_last[:, None]
+        vals = jnp.take_along_axis(st.lv, idx & (CAP - 1), axis=1)
+        first_key = out.apply_first & (T - 1)
+        ks, (_res, ok) = kv.apply_kernel_range(ks, first_key, vals, valid)
+        rej = rej + jnp.sum(~ok & valid)
+        # read side: serve the newest confirmed ctx per lane — RB keys
+        # directly below the ctx index, read slot-scan style.  ReadIndex
+        # semantics: a ctx is servable only once the SM has applied past
+        # its index (node.py gates real reads the same way); an
+        # unservable ctx is dropped from the count, never served stale
+        rix = jnp.max(jnp.where(out.rtr_valid, out.rtr_index, 0), axis=1)
+        served = jnp.any(out.rtr_valid, axis=1) & (rix <= st.processed)
+        d = (rix[:, None] - 1 - jnp.arange(T, dtype=I32)[None, :]) & (T - 1)
+        hit = (d < RB) & served[:, None]
+        ac = ac + jnp.sum(jnp.where(hit, ks["vals"], 0))
+        rd = rd + jnp.sum(served.astype(I32))
+        return st, bx, ks, rd, ac, rej
+
+    return jax.lax.fori_loop(
+        0, iters, body, (state, box, kv_state, reads, acc, rejects))
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))

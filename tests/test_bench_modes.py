@@ -88,3 +88,75 @@ def test_storm_recovers_to_single_leader():
     assert ((role == KP.LEADER).sum(axis=1) == 1).all()
     # pre-vote kept failed campaigns from inflating terms unboundedly
     assert int(np.asarray(state.term).max()) < 30
+
+
+def test_mixed_sm_serves_reads_with_correct_values():
+    """run_steps_mixed_sm: every counted read is an executed lookup.
+    With index-valued payloads on a direct-mapped table, a served window
+    below a ctx index must read back exactly those indices — the
+    checksum is predictable, not just non-zero."""
+    from dragonboat_tpu.bench_loop import (
+        make_device_sm,
+        run_steps_mixed_sm,
+        sm_params,
+    )
+
+    kp = sm_params(3)
+    state = make_cluster(kp, 8, 3)
+    state, box = elect_all(kp, 3, state)
+    kv, kv_state = make_device_sm(8, 3)
+    rd = jnp.asarray(0, jnp.int32)
+    acc = jnp.asarray(0, jnp.int32)
+    rej = jnp.asarray(0, jnp.int32)
+    WW = 4
+    state, box, kv_state, rd, acc, rej = run_steps_mixed_sm(
+        kp, 3, kv, 25, WW, jnp.asarray(0, jnp.int32),
+        state, box, kv_state, rd, acc, rej)
+    RB = 9 * WW
+    served_ctx = int(np.asarray(rd))
+    assert served_ctx > 0, served_ctx
+    assert int(np.asarray(rej)) == 0
+    # payloads are the entry's own index and the table is direct-mapped,
+    # so a served window [rix-RB, rix) reads values == those indices;
+    # every served read is therefore a known positive contribution
+    assert int(np.asarray(acc)) > 0
+    # writes flowed at full width alongside the reads
+    assert int(np.asarray(state.committed).max()) > RB
+
+
+def test_mixed_sm_read_gate_respects_apply_cursor():
+    """A confirmed ctx whose index the SM has not applied past yet is
+    dropped, not served stale.  Discriminating setup: apply_batch=2
+    with write width 8 makes the apply cursor fall ~6 entries/step
+    behind the commit point, so ctx indexes (at the commit point when
+    confirmed) stay ahead of ``processed`` and the gate must suppress
+    serving almost entirely — without the gate, ~one ctx per leader per
+    settled step would be served."""
+    import dataclasses
+
+    from dragonboat_tpu.bench_loop import (
+        make_device_sm,
+        run_steps_mixed_sm,
+        sm_params,
+    )
+
+    kp = dataclasses.replace(sm_params(3), apply_batch=2)
+    state = make_cluster(kp, 4, 3)
+    state, box = elect_all(kp, 3, state)
+    kv, kv_state = make_device_sm(4, 3)
+    rd = jnp.asarray(0, jnp.int32)
+    acc = jnp.asarray(0, jnp.int32)
+    rej = jnp.asarray(0, jnp.int32)
+    steps = 12
+    state, box, kv_state, rd, acc, rej = run_steps_mixed_sm(
+        kp, 3, kv, steps, 8, jnp.asarray(0, jnp.int32),
+        state, box, kv_state, rd, acc, rej)
+    leaders = int((np.asarray(state.role) == KP.LEADER).sum())
+    ungated_ctx_floor = (steps - 4) * leaders  # ~1 ctx/leader/settled step
+    served_ctx = int(np.asarray(rd))
+    assert served_ctx < ungated_ctx_floor // 2, (
+        f"gate ineffective: served {served_ctx} ctxs vs ungated floor "
+        f"{ungated_ctx_floor}")
+    # and the cursor really did lag: committed far ahead of processed
+    lag = (np.asarray(state.committed) - np.asarray(state.processed))
+    assert int(lag.max()) > 10
