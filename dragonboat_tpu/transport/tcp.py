@@ -24,6 +24,10 @@ RAFT_TYPE = 100
 SNAPSHOT_TYPE = 200
 _REQ_HDR = struct.Struct(">HQII")     # method, size, header-crc, payload-crc
 MAX_FRAME = 1 << 30
+# the reference's per-request preamble (tcp.go:43-44): 2 magic bytes
+# before every header; the all-zero poison announces a clean close
+GO_MAGIC = b"\xae\x7d"
+GO_POISON = b"\x00\x00"
 
 
 def _encode_header(method: int, payload: bytes) -> bytes:
@@ -64,7 +68,9 @@ class _TCPConn:
     """Cached outbound connection (TCPConnection, tcp.go:298)."""
 
     def __init__(self, target: str,
-                 client_ctx: ssl.SSLContext | None = None) -> None:
+                 client_ctx: ssl.SSLContext | None = None,
+                 wire: str = "native") -> None:
+        self.wire = wire
         host, port = target.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=5)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -77,6 +83,14 @@ class _TCPConn:
         self.mu = threading.Lock()
 
     def close(self) -> None:
+        if self.wire == "go":
+            # clean-close handshake (tcp.go sendPoison): a reference
+            # peer distinguishes shutdown from a dropped connection
+            try:
+                with self.mu:
+                    self.sock.sendall(GO_POISON)
+            except OSError:
+                pass
         try:
             self.sock.close()
         except OSError:
@@ -84,9 +98,29 @@ class _TCPConn:
 
     def send_message_batch(self, batch: pb.MessageBatch) -> None:
         with self.mu:
-            _send_frame(self.sock, RAFT_TYPE, pb.encode_message_batch(batch))
+            if self.wire == "go":
+                from dragonboat_tpu.raftpb import gowire
+
+                payload = gowire.encode_message_batch(
+                    batch.requests, batch.deployment_id,
+                    batch.source_address, batch.bin_ver)
+                # one buffer, one syscall: with TCP_NODELAY a separate
+                # magic write would emit its own 2-byte segment per batch
+                self.sock.sendall(GO_MAGIC +
+                                  _encode_header(RAFT_TYPE, payload) +
+                                  payload)
+            else:
+                _send_frame(self.sock, RAFT_TYPE,
+                            pb.encode_message_batch(batch))
 
     def send_chunk(self, chunk: pb.Chunk) -> None:
+        if self.wire == "go":
+            # descope (documented): go-wire mode carries raft traffic;
+            # snapshot streaming between heterogeneous fleets goes
+            # through export/import (tools.py), not the chunk stream
+            raise NotImplementedError(
+                "go-wire snapshot streaming is out of scope; "
+                "use export/import across fleets")
         with self.mu:
             _send_frame(self.sock, SNAPSHOT_TYPE, pb.encode_chunk(chunk))
 
@@ -118,6 +152,14 @@ class _ConnProxy(IConnection):
             m = chunk.get("message")
             raise ValueError("tcp transport requires pb.Chunk, got dict "
                              f"(message={m is not None})")
+        if self.transport.wire == "go":
+            # reject BEFORE the connection path: routing the descope
+            # error through _call would evict the healthy shared raft
+            # connection and feed the per-address breaker on every
+            # InstallSnapshot retry
+            raise NotImplementedError(
+                "go-wire snapshot streaming is out of scope; "
+                "use export/import across fleets")
         self._call("send_chunk", chunk)
 
 
@@ -127,7 +169,11 @@ class TCPTransport(ITransport):
     def __init__(self, addr: str, message_handler, chunk_handler,
                  listen_addr: str = "",
                  server_ctx: ssl.SSLContext | None = None,
-                 client_ctx: ssl.SSLContext | None = None) -> None:
+                 client_ctx: ssl.SSLContext | None = None,
+                 wire: str = "native") -> None:
+        if wire not in ("native", "go"):
+            raise ValueError(f"unknown wire {wire!r}")
+        self.wire = wire
         self.addr = addr
         # ListenAddress (config.go): where to bind; RaftAddress is what is
         # advertised to peers (NAT / 0.0.0.0 binds)
@@ -143,7 +189,8 @@ class TCPTransport(ITransport):
         self._accepted: set[socket.socket] = set()
 
     def name(self) -> str:
-        return "tcp-transport"
+        return ("tcp-transport" if self.wire == "native"
+                else "go-tcp-transport")
 
     def start(self) -> None:
         host, port = self.listen_addr.rsplit(":", 1)
@@ -217,13 +264,37 @@ class TCPTransport(ITransport):
                             self._accepted.discard(plain)
                             self._accepted.add(sock)
             while self.running:
+                if self.wire == "go":
+                    # per-request preamble (tcp.go readMagicNumber):
+                    # magic continues, poison is a clean close
+                    pre = _recv_exact(sock, 2)
+                    if pre == GO_POISON:
+                        break
+                    if pre != GO_MAGIC:
+                        raise ValueError("bad magic")
                 raw = _recv_exact(sock, _REQ_HDR.size)
                 method, size, pcrc = _decode_header(raw)
                 payload = _recv_exact(sock, size)
                 if zlib.crc32(payload) != pcrc:
                     raise ValueError("payload crc mismatch")
+                if method == SNAPSHOT_TYPE and self.wire == "go":
+                    # symmetric with the send-side descope: a reference
+                    # peer's chunk stream is rejected explicitly, not fed
+                    # to the native chunk codec
+                    raise ValueError(
+                        "snapshot stream on the go wire is out of scope")
                 if method == RAFT_TYPE:
-                    self.message_handler(pb.decode_message_batch(payload))
+                    if self.wire == "go":
+                        from dragonboat_tpu.raftpb import gowire
+
+                        reqs, dep, src, ver = gowire.decode_message_batch(
+                            payload)
+                        batch = pb.MessageBatch(
+                            requests=reqs, deployment_id=dep,
+                            source_address=src, bin_ver=ver)
+                    else:
+                        batch = pb.decode_message_batch(payload)
+                    self.message_handler(batch)
                 else:
                     self.chunk_handler(pb.decode_chunk(payload))
         except (ConnectionError, ValueError, OSError):
@@ -242,7 +313,8 @@ class TCPTransport(ITransport):
         with self.mu:
             c = self.conns.get(target)
             if c is None:
-                c = self.conns[target] = _TCPConn(target, self.client_ctx)
+                c = self.conns[target] = _TCPConn(target, self.client_ctx,
+                                                  wire=self.wire)
             return c
 
     def _evict(self, target: str, conn: _TCPConn) -> None:
@@ -281,14 +353,25 @@ def _tls_contexts(nhconfig):
 
 
 class TCPTransportFactory:
-    """config.TransportFactory for real sockets (DefaultTransportFactory)."""
+    """config.TransportFactory for real sockets (DefaultTransportFactory).
+
+    ``wire="go"`` makes every connection speak the reference's exact
+    byte format — the 2-byte magic preamble + 18-byte crc'd request
+    header (tcp.go:43,64-110) around a gogo-protobuf MessageBatch
+    (raftpb/gowire.py) — so a host can exchange raft traffic with
+    reference hosts over DCN.  Snapshot streaming in go mode is a
+    documented descope (export/import crosses fleets)."""
+
+    def __init__(self, wire: str = "native") -> None:
+        self.wire = wire
 
     def create(self, nhconfig, message_handler, chunk_handler) -> TCPTransport:
         server_ctx, client_ctx = _tls_contexts(nhconfig)
         return TCPTransport(nhconfig.raft_address, message_handler,
                             chunk_handler,
                             listen_addr=nhconfig.listen_address,
-                            server_ctx=server_ctx, client_ctx=client_ctx)
+                            server_ctx=server_ctx, client_ctx=client_ctx,
+                            wire=self.wire)
 
     def validate(self, addr: str) -> bool:
         try:
