@@ -225,6 +225,10 @@ class _LaneInit:
 class KernelEngine:
     """Owns one batched kernel state and every KernelNode mapped onto it."""
 
+    # class-wide: serializes the FIRST jit compile across engines (see
+    # step_all; concurrent engine-thread compiles segfaulted XLA:CPU)
+    _first_compile_mu = threading.Lock()
+
     def __init__(self, kp: KP.KernelParams, capacity: int,
                  send_message, events: EventHub | None = None,
                  election_rtt: int = 10, heartbeat_rtt: int = 1) -> None:
@@ -268,6 +272,9 @@ class KernelEngine:
         # staging land here); step_all drains it instead of sweeping all
         # [capacity] rows for vanished registrations
         self._removed_nodes: list[KernelNode] = []
+        # first-call guard for the cross-engine compile serialization in
+        # step_all (the class-wide _first_compile_mu)
+        self._compiled_once = False
         # host mirror of the device peer-kind book: kinds only change on
         # injection/membership updates, so the output path must not pay a
         # device->host transfer for them every step
@@ -576,7 +583,17 @@ class KernelEngine:
 
             with self._step_timer.measure():
                 with annotate("kernel_engine.step"):
-                    state, out = self._kernel_call(inbox, inp)
+                    if not self._compiled_once:
+                        # serialize FIRST calls across engines (incl. the
+                        # mesh override): concurrent jit compiles from
+                        # several engine threads have segfaulted XLA:CPU
+                        # (2026-07-31); once the executable is cached the
+                        # lock is never touched again
+                        with KernelEngine._first_compile_mu:
+                            state, out = self._kernel_call(inbox, inp)
+                        self._compiled_once = True
+                    else:
+                        state, out = self._kernel_call(inbox, inp)
                 with annotate("kernel_engine.process_outputs"):
                     self.state = state
                     self._process_outputs(nodes, out)

@@ -71,6 +71,9 @@ class NodeHostInfo:
 
 
 class NodeHost:
+    # serializes the process-global threading.stack_size() window below
+    _stack_size_mu = threading.Lock()
+
     def __init__(self, nhconfig: NodeHostConfig,
                  logdb: ILogDB | None = None,
                  auto_run: bool = True) -> None:
@@ -206,16 +209,33 @@ class NodeHost:
             num_workers=max(1, min(nhconfig.expert.engine.apply_shards, 16)),
             on_work_done=self._work.set, name=f"apply-{self.id[:8]}")
         if auto_run:
-            self._engine_thread = threading.Thread(
-                target=self._engine_main, name=f"engine-{self.id[:12]}",
-                daemon=True)
-            self._engine_thread.start()
-            for w in range(self._num_workers):
-                t = threading.Thread(target=self._worker_main, args=(w,),
-                                     name=f"exec-{w}-{self.id[:8]}",
-                                     daemon=True)
-                t.start()
-                self._workers.append(t)
+            # worker threads jit-compile the step kernel on their first
+            # engine iteration; XLA's compile recursion on large graphs
+            # overflows the default pthread stack (observed as a segfault
+            # inside backend_compile in exec-0 threads, 2026-07-31), so
+            # engine threads get a deep stack.  stack_size() is process-
+            # global for threads created while set — the class lock keeps
+            # concurrent NodeHost constructions from racing the window.
+            with NodeHost._stack_size_mu:
+                prev_stack = threading.stack_size()
+                try:
+                    threading.stack_size(64 << 20)
+                except (ValueError, RuntimeError):
+                    prev_stack = None
+                try:
+                    self._engine_thread = threading.Thread(
+                        target=self._engine_main,
+                        name=f"engine-{self.id[:12]}", daemon=True)
+                    self._engine_thread.start()
+                    for w in range(self._num_workers):
+                        t = threading.Thread(
+                            target=self._worker_main, args=(w,),
+                            name=f"exec-{w}-{self.id[:8]}", daemon=True)
+                        t.start()
+                        self._workers.append(t)
+                finally:
+                    if prev_stack is not None:
+                        threading.stack_size(prev_stack)
 
     # -- lifecycle ------------------------------------------------------
 

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Full test suite in three file-chunked processes.
+#
+# Why not one `pytest tests/`: on this 1-core box, a single process that
+# has executed ~300 tests crashes inside XLA:CPU's compile/deserialize
+# path (SIGABRT in compilation-cache load or SIGSEGV in
+# backend_compile, always in an engine thread) when it next touches a
+# jitted engine executable.  Four full-run reproductions on 2026-07-31
+# all died this way at a late collection position, while every file
+# subset — including the exact crash-position test — passes in a fresh
+# process, with identical code and a warm cache.  Deep engine-thread
+# stacks and cross-engine first-compile serialization (both now in the
+# product) narrowed but did not remove it; chunking bounds process age
+# instead.  Exit status is non-zero if any chunk fails.
+set -e
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+rc=0
+run() {
+    echo "== chunk: $* =="
+    PYTHONPATH= "$PY" -m pytest "$@" -q || rc=$?
+}
+run tests/test_zz_kernel_scale.py tests/test_zz_mesh_scale.py
+run tests/test_a*.py tests/test_b*.py tests/test_d*.py tests/test_e*.py \
+    tests/test_f*.py tests/test_g*.py tests/test_h*.py tests/test_k*.py
+run tests/test_m*.py tests/test_n*.py tests/test_r*.py tests/test_s*.py \
+    tests/test_t*.py tests/test_v*.py
+exit $rc

@@ -74,3 +74,19 @@ def pytest_runtest_protocol(item, nextitem):
     item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
                                         location=item.location)
     return True
+
+
+def pytest_collection_modifyitems(session, config, items):
+    """Big-shape jit tests run FIRST.
+
+    Compiling — or even cache-LOADING — the large kernel executables
+    (1k-lane engines, the 8-device mesh) after ~340 tests of process
+    aging aborts inside XLA's compile/deserialize path (diagnosed
+    2026-07-31: deterministic SIGABRT/SIGSEGV at the same collection
+    position across four full-suite runs, while every subset and a
+    fresh process pass).  A fresh process handles the big shapes
+    reliably, so they go to the front of the run."""
+    big = [it for it in items if "test_zz_" in it.nodeid]
+    if big:
+        rest = [it for it in items if "test_zz_" not in it.nodeid]
+        items[:] = big + rest
