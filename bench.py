@@ -15,12 +15,19 @@ Baseline: the reference's 9M writes/s peak (3× 22-core Xeon servers,
 BASELINE.md) — vs_baseline is measured/9e6.
 
 Phases (one JSON line carries all of them): A headline write throughput
-(uninstrumented), A2 commit-latency percentiles (stamp-ring instrumented
-loop, leader-side release), B 9:1 ReadIndex:write mix (config #3), C
-10k-shard election storm with randomized drops + pre-vote (config #4),
-D membership-change wave + device log compaction under load (config #5:
+(uninstrumented, MEDIAN-OF-3 timed windows with a cross-phase
+contention verdict — a noisy box inflates a window, it must not inflate
+the record), A2 commit-latency percentiles (stamp-ring instrumented
+loop, leader-side release), B 9:1 ReadIndex:write PERMIT capacity
+(secondary diagnostic), B2 9:1 mix with reads SERVED against the
+device-resident state machine (THE config-#3 number —
+read_accounting: "served"; BENCH_SERVED=0 skips), C 10k-shard election
+storm with randomized drops + pre-vote (config #4), D
+membership-change wave + device log compaction under load (config #5:
 every group commits a CC mid-stream; BENCH_CC=0 skips,
-BENCH_CC_ROUNDS sets the wave count).
+BENCH_CC_ROUNDS sets the wave count).  BENCH_TIME_BUDGET (default
+2400 s) soft-bounds the run: a phase that would overrun is skipped
+with a note in the record, never silently truncated.
 
 Env knobs: BENCH_GROUPS (default 8192 on device, 1024 on the CPU
 fallback — one core crunches the batch serially, so scale only slows the
@@ -205,6 +212,80 @@ def _run_storm(platform: str) -> dict:
     }
 
 
+def _run_served(replicas: int, groups: int, mixed_steps: int,
+                write_width: int, chunk: int) -> dict:
+    """Phase B2: the 9:1 mix with every read EXECUTED against the
+    device-resident table (run_steps_mixed_sm) — a fresh device-SM
+    cluster at the bench G, its own warmup, its own timed window.
+    Standalone so the main-phase state is untouched and a failure here
+    cannot poison the rest of the record."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dragonboat_tpu.bench_loop import (
+        elect_all,
+        make_cluster,
+        make_device_sm,
+        run_steps_mixed_sm,
+        sm_params,
+    )
+    from dragonboat_tpu.core import params as KP
+
+    kp = sm_params(replicas)
+    state = make_cluster(kp, groups, replicas)
+    state, box = elect_all(kp, replicas, state)
+    lead = np.asarray(state.role) == KP.LEADER
+    kv, kv_state = make_device_sm(groups, replicas)
+    WW = max(1, min(kp.proposal_cap, write_width))
+    rd = jnp.asarray(0, jnp.int32)
+    acc = jnp.asarray(0, jnp.int32)
+    rej = jnp.asarray(0, jnp.int32)
+    now = 0
+
+    def run(iters):
+        nonlocal state, box, kv_state, rd, acc, rej, now
+        state, box, kv_state, rd, acc, rej = run_steps_mixed_sm(
+            kp, replicas, kv, iters, WW, jnp.asarray(now, jnp.int32),
+            state, box, kv_state, rd, acc, rej)
+        now += iters
+
+    def committed() -> int:
+        return int(np.asarray(state.committed)[lead].astype(np.int64).sum())
+
+    # warm the exact chunk/remainder executables outside the window
+    run(min(chunk, mixed_steps))
+    if mixed_steps % chunk:
+        run(mixed_steps % chunk)
+    state.committed.block_until_ready()
+    c0, r0 = committed(), int(np.asarray(rd))
+    t0 = time.time()
+    done = 0
+    while done < mixed_steps:
+        n = min(chunk, mixed_steps - done)
+        run(n)
+        done += n
+    state.committed.block_until_ready()
+    dt = time.time() - t0
+    writes = committed() - c0
+    served = (int(np.asarray(rd)) - r0) * 9 * WW
+    # the declared mix is 9:1 — lookups beyond 9 per committed write
+    # are executed but do not count toward the mixed number
+    reads_ops = min(served, 9 * writes)
+    ops = (writes + reads_ops) / dt
+    return {
+        "read_accounting": "served",
+        "ops_per_s": round(ops),
+        "writes_per_s": round(writes / dt),
+        "reads_served_per_s": round(served / dt),
+        "read_checksum": int(np.asarray(acc)),
+        "sm_rejected_writes": int(np.asarray(rej)),
+        "steps": mixed_steps,
+        "step_ms": round(dt / mixed_steps * 1e3, 3),
+        "table": "direct-mapped",
+        "vs_baseline_mixed": round(ops / 11e6, 4),
+    }
+
+
 def _measure(platform: str, groups: int, steps: int) -> None:
     import numpy as np
 
@@ -230,6 +311,14 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     import jax.numpy as jnp
 
     t_build = time.time()
+    # soft wall budget: the driver/watcher runs this under an external
+    # timeout — a phase that would overrun it must be skipped WITH a
+    # note rather than silently truncating the record (VERDICT r4: the
+    # artifact is the scoreboard)
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET", "2400"))
+
+    def time_left(margin_s: float) -> bool:
+        return (time.time() - t_build) < (budget_s - margin_s)
     state = make_cluster(kp, groups, replicas)
     state, box = elect_all(kp, replicas, state)
     lead = np.asarray(state.role) == KP.LEADER
@@ -300,22 +389,54 @@ def _measure(platform: str, groups: int, steps: int) -> None:
 
     # ---- phase A: write-only throughput (the headline metric runs the
     # UNinstrumented loop; latency capture is a separate phase below —
-    # its stamp/histogram one-hots roughly double the step cost) ----
+    # its stamp/histogram one-hots roughly double the step cost).
+    # Measured as MEDIAN-OF-3 windows: one long window has no defense
+    # against a transiently noisy box (the r2->r4 headline decline was
+    # measurement contention, not code — PERF.md), and the lower-middle
+    # median discards a single inflated window while never inventing a
+    # number faster than a window actually measured. ----
     def plain_run(iters):
         nonlocal state, box
         state, box = run_steps(kp, replicas, iters, True, True, state, box)
 
     snaps = {}
+    windows: list[dict] = []
+    wsteps = max(20, steps // 3)
 
-    def snap_a():
-        sm_rejects.clear()  # warmup-phase rejects are outside the window
-        snaps["c0"] = committed()
+    def run_a_window():
+        def snap():
+            sm_rejects.clear()  # warmup rejects are outside the window
+            snaps["c0"] = committed()
+
+        warm, dtw = timed_window(plain_run, wsteps, snap)
+        # accumulate in-window rejects across windows (the clear above
+        # discards only warmup-segment rejects)
+        snaps["rej"] = snaps.get("rej", 0) + sum(int(r) for r in sm_rejects)
+        w = int(committed() - snaps["c0"])
+        windows.append({
+            "steps": wsteps,
+            "wall_s": round(dtw, 3),
+            "step_ms": round(dtw / wsteps * 1e3, 3),
+            "writes": w,
+            "writes_per_s": round(w / dtw),
+        })
+        return warm
+
+    def median_window() -> dict:
+        # lower-middle: contention only ever inflates a window, so ties
+        # break toward the measurement the box actually achieved
+        ws = sorted(windows, key=lambda r: r["step_ms"])
+        return ws[(len(ws) - 1) // 2]
 
     t0 = time.time()
-    compile_s, dt = timed_window(plain_run, steps, snap_a)
-    writes = int(committed() - snaps["c0"])
-    wps = writes / dt
-    step_ms = dt / steps * 1e3
+    compile_s = run_a_window()
+    for _ in range(2):
+        run_a_window()
+    med = median_window()
+    writes = sum(w["writes"] for w in windows)
+    dt = sum(w["wall_s"] for w in windows)
+    wps = med["writes_per_s"]
+    step_ms = med["step_ms"]
 
     # provisional record: if a slow-tunnel run is killed externally in a
     # later phase, the LAST stdout line is still a valid measurement of
@@ -337,59 +458,33 @@ def _measure(platform: str, groups: int, steps: int) -> None:
     detail = {
         "platform": platform,
         "groups": groups,
-        "steps": steps,
+        "steps": len(windows) * wsteps,
         "wall_s": round(dt, 3),
         "step_ms": round(step_ms, 3),
         "writes": writes,
-        "writes_per_group_step": round(writes / steps / groups, 2),
+        "writes_per_group_step": round(
+            med["writes"] / med["steps"] / groups, 2),
+        "headline_policy": "lower-median of timed windows",
+        "headline_windows": windows,
         "warmup_steps_s": round(compile_s, 1),
         "total_setup_s": round(t0 - t_build + compile_s, 1),
     }
     if device_sm:
-        detail["sm_rejected_writes"] = int(sum(int(r) for r in sm_rejects))
+        detail["sm_rejected_writes"] = int(snaps.get("rej", 0))
         detail["sm_apply"] = ("pallas" if kv.use_pallas else
                               ("range" if not kv.hash_keys else "scan"))
-        # ---- device-SM phase B: 9:1 mix with reads SERVED against the
-        # device table (run_steps_mixed_sm: every counted read is an
-        # executed lookup whose value lands in the checksum carry) ----
-        if not kv.use_pallas and not kv.hash_keys:
-            from dragonboat_tpu.bench_loop import run_steps_mixed_sm
-
-            mixed_steps = int(os.environ.get(
-                "BENCH_MIXED_STEPS", str(max(40, steps // 2))))
-            WW = max(1, min(B, int(os.environ.get(
-                "BENCH_MIXED_WRITE_WIDTH", str(B)))))
-            rd = jnp.asarray(0, jnp.int32)
-            acc = jnp.asarray(0, jnp.int32)
-            rej = jnp.asarray(0, jnp.int32)
-
-            def mixed_sm_run(iters):
-                nonlocal state, box, kv_state, rd, acc, rej, now
-                state, box, kv_state, rd, acc, rej = run_steps_mixed_sm(
-                    kp, replicas, kv, iters, WW,
-                    jnp.asarray(now, jnp.int32), state, box, kv_state,
-                    rd, acc, rej)
-                now += iters
-
-            def snap_sm():
-                snaps["smr0"], snaps["smc0"] = int(np.asarray(rd)), committed()
-
-            _, dtB = timed_window(mixed_sm_run, mixed_steps, snap_sm)
-            writes_b = int(committed() - snaps["smc0"])
-            # rd counts served ctxs; the lookup count multiplies host-side
-            served = (int(np.asarray(rd)) - snaps["smr0"]) * 9 * WW
-            reads_ops = min(served, 9 * writes_b)
-            detail["mixed_9to1_served"] = {
-                "ops_per_s": round((writes_b + reads_ops) / dtB),
-                "writes_per_s": round(writes_b / dtB),
-                "reads_served_per_s": round(served / dtB),
-                "read_checksum": int(np.asarray(acc)),
-                "sm_rejected_writes": int(np.asarray(rej)),
-                "steps": mixed_steps,
-                "step_ms": round(dtB / mixed_steps * 1e3, 3),
-                "vs_baseline_mixed": round(
-                    (writes_b + reads_ops) / dtB / 11e6, 4),
-            }
+        # ---- device-SM phase B: the same served-read mix the default
+        # bench records — ONE implementation (_run_served) so the two
+        # modes cannot drift in accounting or record schema ----
+        mixed_steps = int(os.environ.get(
+            "BENCH_MIXED_STEPS", str(max(40, steps // 2))))
+        WW = max(1, min(B, int(os.environ.get(
+            "BENCH_MIXED_WRITE_WIDTH", str(B)))))
+        try:
+            detail["mixed_9to1_served"] = _run_served(
+                replicas, groups, mixed_steps, WW, chunk)
+        except Exception as e:
+            detail["mixed_9to1_served"] = {"error": repr(e)[-300:]}
     else:
         # ---- phase A2: commit-latency percentiles (instrumented loop) ----
         lat_steps = int(os.environ.get("BENCH_LAT_STEPS",
@@ -452,19 +547,48 @@ def _measure(platform: str, groups: int, steps: int) -> None:
         read_batch = 9 * WW
         reads_ops = min(ctx * read_batch, 9 * writes_b)
         mixed_ops = (writes_b + reads_ops) / dtB
-        detail["mixed_9to1"] = {
-            # reads here are ReadIndex PERMITS (confirmed-ctx batch
-            # capacity, capped at 9 per committed write); the device-SM
-            # mode's mixed_9to1_served block executes every counted read
-            # against the device table instead
+        mixed_step_ms = dtB / mixed_steps * 1e3
+        # SECONDARY diagnostic: reads here are ReadIndex PERMITS
+        # (confirmed-ctx batch capacity, capped at 9 per committed
+        # write), NOT executed lookups — the recorded config-#3 number
+        # is mixed_9to1_served below, where every counted read is a real
+        # table lookup.  No vs_baseline field here on purpose: permit
+        # capacity must not be comparable against the reference's 11M
+        # served ops/s.
+        detail["mixed_9to1_permits"] = {
             "read_accounting": "permits",
             "ops_per_s": round(mixed_ops),
             "writes_per_s": round(writes_b / dtB),
             "read_ctx_per_s": round(ctx / dtB),
             "read_batch_per_ctx": read_batch,
             "steps": mixed_steps,
-            "step_ms": round(dtB / mixed_steps * 1e3, 3),
-            "vs_baseline_mixed": round(mixed_ops / 11e6, 4),
+            "step_ms": round(mixed_step_ms, 3),
+        }
+
+        # ---- cross-phase consistency: the mixed loop runs the SAME
+        # kernel plus ReadIndex work, so write-only step_ms above mixed
+        # step_ms by >15% means phase A was measured on a contended box
+        # (exactly r4's self-contradicting record).  Re-measure phase A
+        # once and let the median absorb the inflated windows. ----
+        contended = step_ms > 1.15 * mixed_step_ms
+        if contended:
+            run_a_window()
+            med = median_window()
+            writes = sum(w["writes"] for w in windows)
+            dt = sum(w["wall_s"] for w in windows)
+            wps = med["writes_per_s"]
+            step_ms = med["step_ms"]
+            detail.update(
+                steps=len(windows) * wsteps,
+                wall_s=round(dt, 3), step_ms=round(step_ms, 3),
+                writes=writes,
+                writes_per_group_step=round(
+                    med["writes"] / med["steps"] / groups, 2))
+        detail["contention"] = {
+            "write_only_vs_mixed_step": round(
+                step_ms / max(mixed_step_ms, 1e-9), 3),
+            "detected": bool(contended),
+            "extra_windows_measured": len(windows) - 3,
         }
 
         # ---- phase D: membership-change wave + compaction under load
@@ -523,12 +647,38 @@ def _measure(platform: str, groups: int, steps: int) -> None:
                 "compaction_floor_advance": snap1 - snap0,
             }
 
+        # ---- phase B2: 9:1 mix with reads SERVED — the recorded
+        # config-#3 number.  A fresh device-SM cluster at the same G:
+        # payloads ride the replicated lv ring into the range apply, and
+        # every counted read is an EXECUTED slot-scan lookup against the
+        # device-resident table, checksum-folded so XLA cannot elide it
+        # (bench_loop.run_steps_mixed_sm).  Direct-mapped table: raft
+        # applies a contiguous index window, which is also the
+        # reference's bench-SM shape (kvtest-style fixed keyspace);
+        # hashed-table serving exists and is differential-tested, but
+        # its probing apply measures the hash scheme, not the mix. ----
+        if os.environ.get("BENCH_SERVED", "1") != "1":
+            detail["mixed_9to1_served"] = {"skipped": "BENCH_SERVED=0"}
+        elif not time_left(180):
+            detail["mixed_9to1_served"] = {
+                "skipped": "time budget exhausted before served phase"}
+        else:
+            try:
+                detail["mixed_9to1_served"] = _run_served(
+                    replicas, groups, mixed_steps, WW, chunk)
+            except Exception as e:  # must not cost the whole record
+                detail["mixed_9to1_served"] = {"error": repr(e)[-300:]}
+
         # ---- phase C: 10k-shard election storm (config #4) ----
         if os.environ.get("BENCH_STORM", "1") == "1":
-            try:
-                detail["election_storm"] = _run_storm(platform)
-            except Exception as e:  # storm failure must not cost the run
-                detail["election_storm"] = {"error": repr(e)[-300:]}
+            if time_left(240):
+                try:
+                    detail["election_storm"] = _run_storm(platform)
+                except Exception as e:  # failure must not cost the run
+                    detail["election_storm"] = {"error": repr(e)[-300:]}
+            else:
+                detail["election_storm"] = {
+                    "skipped": "time budget exhausted before storm phase"}
 
     sm_note = ", device-SM apply" if device_sm else ""
     emit({

@@ -103,26 +103,8 @@ def _slot(kp: P.KernelParams, idx):
     return idx & (kp.log_cap - 1)
 
 
-class _PendingAppend(NamedTuple):
-    """One deferred noop append (the merged inbox families never write
-    the log ring in-loop; at most ONE leader transition can fire per
-    lane per step, so the ring write is recorded here and applied once
-    after the family completes — see _process_family_merged)."""
-
-    mask: jnp.ndarray   # bool — an append is pending
-    idx: jnp.ndarray    # its log index
-    term: jnp.ndarray   # its term
-
-
-def _no_pending_append() -> _PendingAppend:
-    z = jnp.asarray(0, I32)
-    return _PendingAppend(mask=jnp.asarray(False), idx=z, term=z)
-
-
-def log_term_at(kp: P.KernelParams, s: ShardState, idx, defer=None):
-    """(term, compacted, unavailable) for index idx.  ``defer`` overlays
-    a not-yet-applied noop append so in-loop readers see the same log a
-    serial (eager-append) execution would."""
+def log_term_at(kp: P.KernelParams, s: ShardState, idx):
+    """(term, compacted, unavailable) for index idx."""
     in_ring = (idx > s.snap_index) & (idx <= s.last)
     t = sel(
         idx == 0,
@@ -130,20 +112,18 @@ def log_term_at(kp: P.KernelParams, s: ShardState, idx, defer=None):
         sel(idx == s.snap_index, s.snap_term,
             sel(in_ring, _get1(kp, s.lt, _slot(kp, idx)), 0)),
     )
-    if defer is not None:
-        t = sel(defer.mask & (idx == defer.idx), defer.term, t)
     compacted = idx < s.snap_index
     unavailable = idx > s.last
     return t, compacted, unavailable
 
 
-def match_term(kp, s, idx, term, defer=None):
-    t, comp, unav = log_term_at(kp, s, idx, defer)
+def match_term(kp, s, idx, term):
+    t, comp, unav = log_term_at(kp, s, idx)
     return (~comp) & (~unav) & (t == term)
 
 
-def up_to_date(kp, s, idx, term, defer=None):
-    lt_last, _, _ = log_term_at(kp, s, s.last, defer)
+def up_to_date(kp, s, idx, term):
+    lt_last, _, _ = log_term_at(kp, s, s.last)
     return (term > lt_last) | ((term == lt_last) & (idx >= s.last))
 
 
@@ -195,9 +175,9 @@ def _sorted_match_quorum_index(kp: P.KernelParams, s: ShardState):
     return _get1(kp, srt, pos)
 
 
-def _try_commit(kp, s: ShardState, defer=None) -> ShardState:
+def _try_commit(kp, s: ShardState) -> ShardState:
     q = _sorted_match_quorum_index(kp, s)
-    t, comp, _ = log_term_at(kp, s, q, defer)
+    t, comp, _ = log_term_at(kp, s, q)
     t = sel(comp, 0, t)
     ok = (q > s.committed) & (t == s.term) & (s.role == P.LEADER)
     return mrep(s, ok, committed=q)
@@ -331,41 +311,28 @@ def _append_one(kp, s: ShardState, mask, term, is_cc,
     return mrep(s, mask, last=idx)
 
 
-def _become_leader(kp, s: ShardState, mask, eff: Effects, defer=None):
+def _become_leader(kp, s: ShardState, mask, eff: Effects):
     """Candidate→leader: reset, restore pending-CC flag, append noop
-    (p72 raft thesis), broadcast (raft.go:1038).  With ``defer`` the
-    noop append is recorded instead of written (merged inbox families
-    keep the ring invariant in-loop); a leader transition fires at most
-    once per lane per step, so the record never needs to merge two."""
+    (p72 raft thesis), broadcast (raft.go:1038)."""
     s2 = _reset(s, mask, s.term, True)
     s2 = mrep(s2, mask, role=P.LEADER, leader=s.replica_id)
     cc_pending = _cc_count_in(kp, s2, s2.committed, s2.last) > 0
     s2 = mrep(s2, mask, pending_cc=cc_pending)
-    if defer is None:
-        s2 = _append_one(kp, s2, mask, s2.term, False)
-    else:
-        idx = s2.last + 1
-        defer = _PendingAppend(
-            mask=defer.mask | mask,
-            idx=sel(mask, idx, defer.idx),
-            term=sel(mask, s2.term, defer.term),
-        )
-        s2 = mrep(s2, mask, last=idx)
+    s2 = _append_one(kp, s2, mask, s2.term, False)
     self_mask = _self_slot_mask(s2)
     s2 = s2._replace(
         match=sel(mask & self_mask, s2.last, s2.match),
         next=sel(mask & self_mask, s2.last + 1, s2.next),
     )
-    s2 = _try_commit(kp, s2, defer)
+    s2 = _try_commit(kp, s2)
     eff = eff._replace(
         need_rep=sel(mask, jnp.ones_like(eff.need_rep), eff.need_rep),
         save_from=sel(mask, jnp.minimum(eff.save_from, s2.last), eff.save_from),
     )
-    return s2, eff, defer
+    return s2, eff
 
 
-def _campaign(kp, s: ShardState, eff: Effects, mask, allow_prevote=True,
-              defer=None):
+def _campaign(kp, s: ShardState, eff: Effects, mask, allow_prevote=True):
     """Election entry — handleNodeElection (raft.go:1632): pre-vote campaign
     unless transferring; single-node fast paths to leader."""
     gate = s.committed > s.applied  # conservative config-change gate
@@ -399,8 +366,8 @@ def _campaign(kp, s: ShardState, eff: Effects, mask, allow_prevote=True,
         send_vote=sel(rc & ~single, 1, eff.send_vote),
         vote_hint=sel(rc & ~single, hint, eff.vote_hint),
     )
-    s2, eff, defer = _become_leader(kp, s, rc & single, eff, defer)
-    return s2, eff, defer
+    s2, eff = _become_leader(kp, s, rc & single, eff)
+    return s2, eff
 
 
 # ---------------------------------------------------------------------------
@@ -573,11 +540,8 @@ def _empty_resp(s: ShardState, m, pre: _Pre) -> _Resp:
     )
 
 
-def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
-                 defer=None):
-    """Follower-side Replicate (raft.go:1444 handleReplicateMessage).
-    Never runs in a merged family (its ring writes are real), so
-    ``defer`` only passes through from the 'any' scan."""
+def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
+    """Follower-side Replicate (raft.go:1444 handleReplicateMessage)."""
     E = kp.msg_entries
     h_rep = pre.act & pre.is_follower_like & (m.mtype == MT.REPLICATE)
     s = mrep(s, h_rep, leader=m.from_, e_tick=0)
@@ -656,11 +620,10 @@ def _h_replicate(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
         r_log_index=sel(rejected, m.log_index, r.r_log_index),
         r_hint=sel(rejected, s.last, r.r_hint),
     )
-    return s, eff, r, defer
+    return s, eff, r
 
 
-def _h_heartbeat(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
-                 defer=None):
+def _h_heartbeat(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
     """Follower-side Heartbeat (raft.go:1398 handleHeartbeatMessage)."""
     h_hb = pre.act & pre.is_follower_like & (m.mtype == MT.HEARTBEAT)
     s = mrep(s, h_hb, leader=m.from_, e_tick=0,
@@ -670,17 +633,16 @@ def _h_heartbeat(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
         r_hint=sel(h_hb, m.hint, r.r_hint),
         r_hint_high=sel(h_hb, m.hint_high, r.r_hint_high),
     )
-    return s, eff, r, defer
+    return s, eff, r
 
 
-def _h_votereq(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
-               defer=None):
+def _h_votereq(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
     """RequestVote / RequestPreVote / TimeoutNow (raft.go:1697,1670,2188)."""
     act = pre.act
     # ---- RequestVote ----
     h_rv = act & (m.mtype == MT.REQUEST_VOTE)
     can_grant = (s.vote == 0) | (s.vote == m.from_)
-    utd = up_to_date(kp, s, m.log_index, m.log_term, defer)
+    utd = up_to_date(kp, s, m.log_index, m.log_term)
     grant = h_rv & can_grant & utd
     s = mrep(s, grant, vote=m.from_, e_tick=0)
     r = r._replace(
@@ -698,13 +660,12 @@ def _h_votereq(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
     # ---- TimeoutNow (follower; raft.go:2188) ----
     h_tn = act & (s.role == P.FOLLOWER) & (m.mtype == MT.TIMEOUT_NOW)
     s = mrep(s, h_tn, is_ltt=True)
-    s, eff, defer = _campaign(kp, s, eff, h_tn, defer=defer)
+    s, eff = _campaign(kp, s, eff, h_tn)
     s = mrep(s, h_tn, is_ltt=False)
-    return s, eff, r, defer
+    return s, eff, r
 
 
-def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
-            defer=None):
+def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp):
     """Response-side handlers: vote tallies, replication flow control,
     heartbeat acks, unreachable, snapshot status (raft.go:2246-2267,
     1878, 1912, 1997, 1975)."""
@@ -723,8 +684,7 @@ def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
     votes_for = jnp.sum(s.vgrant.astype(I32))
     votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
     q = _quorum(s)
-    s, eff, defer = _become_leader(kp, s, h_vr & (votes_for == q), eff,
-                                   defer)
+    s, eff = _become_leader(kp, s, h_vr & (votes_for == q), eff)
     s = _become_follower(s, h_vr & (votes_against == q), s.term, 0)
 
     # ---- RequestPreVoteResp (raft.go:2267) ----
@@ -739,8 +699,8 @@ def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
     )
     votes_for = jnp.sum(s.vgrant.astype(I32))
     votes_against = jnp.sum((s.vresp & ~s.vgrant).astype(I32))
-    s, eff, defer = _campaign(kp, s, eff, h_pvr & (votes_for == q),
-                              allow_prevote=False, defer=defer)
+    s, eff = _campaign(kp, s, eff, h_pvr & (votes_for == q),
+                       allow_prevote=False)
     s = _become_follower(s, h_pvr & (votes_against == q), s.term, 0)
 
     # ---- ReplicateResp (leader; raft.go:1878) ----
@@ -771,7 +731,7 @@ def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
     )
     committed_before = s.committed
     s = jax.tree_util.tree_map(
-        lambda a, b: sel(updated, a, b), _try_commit(kp, s, defer), s
+        lambda a, b: sel(updated, a, b), _try_commit(kp, s), s
     )
     commit_advanced = s.committed > committed_before
     # broadcast on commit advance; else resend to the (formerly paused) peer
@@ -838,7 +798,7 @@ def _h_resp(kp, s: ShardState, eff: Effects, m, pre: _Pre, r: _Resp,
         psnap=_set1(s.psnap, sender_slot, 0, h_ss & in_snap),
         pstate=_set1(s.pstate, sender_slot, P.R_WAIT, h_ss & in_snap),
     )
-    return s, eff, r, defer
+    return s, eff, r
 
 
 _FAMILY_HANDLERS = {
@@ -849,16 +809,8 @@ _FAMILY_HANDLERS = {
     "any": (_h_replicate, _h_heartbeat, _h_votereq, _h_resp),
 }
 
-# families whose handlers never write the log ring in-loop (the one
-# exception — become_leader's noop append — is deferred via
-# _PendingAppend): these process UNROLLED in one fused pass instead of
-# a serial lax.scan.  'rep' appends entries for real and 'any' may run
-# the replicate handler, so they stay scanned.
-_MERGED_FAMILIES = ("resp", "hb", "vote")
-
-
 def _process_family(kp: P.KernelParams, family: str, s: ShardState,
-                    eff: Effects, m, defer=None):
+                    eff: Effects, m):
     """One inbound message against one shard, with only ``family``'s
     handlers compiled in — the dispatch-by-type analog of raft.Handle
     (raft.go:1596).  'any' composes every handler (masks are mutually
@@ -867,49 +819,8 @@ def _process_family(kp: P.KernelParams, family: str, s: ShardState,
     s, pre = _preamble(kp, s, m)
     r = _empty_resp(s, m, pre)
     for h in _FAMILY_HANDLERS[family]:
-        s, eff, r, defer = h(kp, s, eff, m, pre, r, defer)
-    return s, eff, r, defer
-
-
-def _apply_deferred_append(kp: P.KernelParams, s: ShardState,
-                           defer: _PendingAppend) -> ShardState:
-    """The single ring write a merged family deferred — identical to the
-    eager _append_one a serial execution would have done (noop entry:
-    is_cc False, zero payload); ``last`` was already advanced when the
-    append was recorded."""
-    slot = _slot(kp, defer.idx)
-    s = s._replace(
-        lt=_set1(s.lt, slot, defer.term, defer.mask),
-        lcc=_set1(s.lcc, slot, False, defer.mask),
-    )
-    if kp.inline_payloads:
-        s = s._replace(lv=_set1(s.lv, slot, jnp.asarray(0, I32), defer.mask))
-    return s
-
-
-def _process_family_merged(kp: P.KernelParams, family: str, s: ShardState,
-                           eff: Effects, sub):
-    """Process a whole family's slots in ONE unrolled, fused pass.
-
-    The r2 measurement that damned unrolling ("11x slower") unrolled the
-    FULL handler matrix, whose replicate body masked-rewrites the
-    [G, log_cap] rings per slot — each slot materialized a fresh ring
-    copy.  The merged families touch only [G] scalars and [G, P] peer
-    books in-loop; the one ring write (become_leader's noop, provably
-    at-most-once per lane per step) is deferred and applied here after
-    the loop, so unrolling costs small fused elementwise chains instead
-    of ring copies — and removes the family's serial scan segments
-    entirely (the TPU roofline's top lever, PERF.md)."""
-    n = jax.tree_util.tree_leaves(sub)[0].shape[0]
-    defer = _no_pending_append()
-    rs = []
-    for j in range(n):
-        m = jax.tree_util.tree_map(lambda a: a[j], sub)
-        s, eff, r, defer = _process_family(kp, family, s, eff, m, defer)
-        rs.append(tuple(r))
-    s = _apply_deferred_append(kp, s, defer)
-    part = tuple(jnp.stack([r[i] for r in rs], 0) for i in range(7))
-    return s, eff, part
+        s, eff, r = h(kp, s, eff, m, pre, r)
+    return s, eff, r
 
 
 # ---------------------------------------------------------------------------
@@ -948,29 +859,19 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
             gather = jnp.asarray(idxs, I32)
             sub = jax.tree_util.tree_map(lambda a: a[gather], box)
 
-        if kp.merge_inbox_families and fam in _MERGED_FAMILIES:
-            # ring-invariant families: one unrolled fused pass, zero
-            # serial segments (see _process_family_merged).  Static
-            # opt-in: XLA:CPU strongly prefers the rolled scan (aliased
-            # carry, in-place updates — 28x, measured 2026-07-30); the
-            # unrolled form exists for the TPU, where each scan
-            # iteration is a separate serial launch.
-            s, eff, part = _process_family_merged(kp, fam, s, eff, sub)
-            r_parts.append(part)
-            continue
-
         def _scan_msg(carry, m, _fam=fam):
             s_, eff_ = carry
-            s_, eff_, r, _ = _process_family(kp, _fam, s_, eff_, m)
+            s_, eff_, r = _process_family(kp, _fam, s_, eff_, m)
             return (s_, eff_), tuple(r)
 
-        # Rolled by default ('rep'/'any' — unrolling materializes a fresh
-        # [G, log_cap] ring copy per slot; measured 11x slower on
-        # XLA:CPU, 2026-07-30, where the rolled carry aliases in place).
-        # kp.unroll_scans flips it for the device A/B: on TPU each scan
-        # iteration is a separate serial launch of the whole body, and
-        # lax.scan's unroll flag is bitwise-neutral (unlike the
-        # restructured merge_inbox_families path).
+        # Rolled by default (unrolling materializes a fresh [G, log_cap]
+        # ring copy per slot in the replicate body; measured 11x slower
+        # on XLA:CPU, 2026-07-30, where the rolled carry aliases in
+        # place — and the hand-restructured merged-family variant that
+        # deferred the ring writes measured slower on BOTH platforms, so
+        # it was removed in r5).  kp.unroll_scans flips lax.scan's
+        # bitwise-neutral unroll flag for the device A/B: on TPU each
+        # scan iteration is a separate serial launch of the whole body.
         (s, eff), part = jax.lax.scan(
             _scan_msg, (s, eff), sub,
             unroll=len(idxs) if kp.unroll_scans else 1)
@@ -1101,7 +1002,7 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     )
     elect = nl & can_campaign & (s.e_tick >= s.rand_timeout)
     s = mrep(s, elect, e_tick=0)
-    s, eff, _ = _campaign(kp, s, eff, elect)
+    s, eff = _campaign(kp, s, eff, elect)
     # leader tick
     lt_ = live_tick & is_leader
     s = mrep(s, lt_, e_tick=s.e_tick + 1)

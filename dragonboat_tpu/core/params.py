@@ -31,14 +31,11 @@ class KernelParams:
     # inline payload lanes (lv ring + ent_val routing) for device-resident
     # RSMs; off by default — host-side-payload deployments skip the cost
     inline_payloads: bool = False
-    # process the ring-invariant inbox families (resp/hb/vote) as one
-    # unrolled fused pass instead of serial lax.scans.  Removes 8 of 10
-    # serial inbox segments per step — the TPU roofline's top lever —
-    # but measured 28x SLOWER on XLA:CPU (the rolled scan's aliased
-    # carry updates in place; the unrolled chain materializes fresh
-    # buffers), so it is opt-in pending an on-device measurement.
-    # Bitwise-identical to the scan either way (differential-tested).
-    merge_inbox_families: bool = False
+    # (merge_inbox_families, a hand-restructured unrolled pass over the
+    # ring-invariant families, lived here r2-r4; it measured slower on
+    # BOTH platforms — 28x on XLA:CPU, +40% on TPU v5e — so it was
+    # removed in r5.  Reviving it would need a new hypothesis for why a
+    # materialized-buffer chain could beat the aliased scan carry.)
     # read dynamically-indexed state (the [log_cap] rings, the [P] peer
     # books, the [RI] read book, the router's [K]/[R] lanes) by one-hot
     # select instead of dynamic indexing.  On TPU the batched gather

@@ -117,6 +117,13 @@ class _SSTable:
             yield self.keys[i], self._value(i)
             i += 1
 
+    def iter_all(self) -> Iterator[tuple[bytes, object]]:
+        """Every record, no artificial upper bound — the compaction
+        merge must never exclude a key (an excluded key is deleted with
+        the old tables)."""
+        for i in range(len(self.keys)):
+            yield self.keys[i], self._value(i)
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()
@@ -244,10 +251,20 @@ class OrderedKV:
                 else:
                     try:
                         self._flush_locked()
-                    except Exception as e:   # KeyboardInterrupt/SystemExit
-                        raise FlushError(    # must propagate unchanged
+                    except Exception as e:
+                        raise FlushError(
                             "flush failed after the batch was made durable"
                         ) from e
+                    except BaseException as e:
+                        # KeyboardInterrupt/SystemExit must propagate
+                        # with their own TYPE (signal semantics), but
+                        # carry the durability fact: the batch was
+                        # WAL-appended + fsynced before the flush, so a
+                        # caller staging side effects on write success
+                        # must NOT roll them back (logdb/kvdb.py
+                        # save_raft_state checks this attribute)
+                        e.batch_durable = True
+                        raise
 
     def put(self, key: bytes, val: bytes, sync: bool = True) -> None:
         self.write_batch([(key, val)], sync=sync)
@@ -310,8 +327,10 @@ class OrderedKV:
             self._compact_locked()
 
     def _merged(self) -> Iterator[tuple[bytes, object]]:
-        """Newest-wins merge of all SSTs (memtable excluded)."""
-        iters = [list(t.iter_range(b"", b"\xff" * 64)) for t in self._ssts]
+        """Newest-wins merge of all SSTs (memtable excluded).  Unbounded
+        iteration: a range-bounded merge would silently drop (then
+        delete) any key past the bound."""
+        iters = [list(t.iter_all()) for t in self._ssts]
         merged: dict[bytes, object] = {}
         for run in iters:                  # oldest first: later wins
             for k, v in run:

@@ -145,7 +145,14 @@ class KVLogDB(ILogDB):
                 self.kv.write_batch(puts, sync=True)
             except FlushError:
                 raise
-            except BaseException:
+            except BaseException as exc:
+                if getattr(exc, "batch_durable", False):
+                    # a KeyboardInterrupt/SystemExit escaping the
+                    # post-fsync flush: the batch IS on disk — rolling
+                    # the marks back would let a later compaction drop
+                    # this batch's own entries as above-watermark while
+                    # the durable _K_MAXINDEX key still claims them
+                    raise
                 for k, v in prev.items():
                     if v is None:
                         self._maxidx.pop(k, None)
@@ -204,8 +211,12 @@ class KVLogDB(ILogDB):
             # FlushError means the put itself landed, so the floor moves.
             try:
                 self.kv.put(_nk(_K_FLOOR, *key), _u64(index))
-            except FlushError:
-                self._floors[key] = index
+            except BaseException as exc:
+                # FlushError (and a batch_durable-tagged interrupt)
+                # means the put itself landed, so the floor moves
+                if isinstance(exc, FlushError) or getattr(
+                        exc, "batch_durable", False):
+                    self._floors[key] = index
                 raise
             self._floors[key] = index
 
@@ -241,12 +252,16 @@ class KVLogDB(ILogDB):
                                                 _ek(*key, (1 << 64) - 1))]
             try:
                 self.kv.write_batch([], dels, sync=True)
-            except FlushError:
-                # the deletion batch IS durable — the in-memory books
-                # must drop with it or a re-added node would inherit a
-                # stale floor/watermark over fresh entries
-                self._floors.pop(key, None)
-                self._maxidx.pop(key, None)
+            except BaseException as exc:
+                # the deletion batch IS durable on FlushError and on a
+                # KeyboardInterrupt/SystemExit tagged batch_durable by
+                # the post-fsync flush — the in-memory books must drop
+                # with it or a re-added node would inherit a stale
+                # floor/watermark over fresh entries
+                if isinstance(exc, FlushError) or getattr(
+                        exc, "batch_durable", False):
+                    self._floors.pop(key, None)
+                    self._maxidx.pop(key, None)
                 raise
             self._floors.pop(key, None)
             self._maxidx.pop(key, None)

@@ -125,7 +125,12 @@ class ShardedLogDB(ILogDB):
                     f"configured {self.engine!r}")
         else:
             with self.fs.open(mp, "wb") as f:
-                f.write(f"{self.num_shards} {self.engine}\n".encode("ascii"))
+                # count alone on line 1: an older (count-only) parser
+                # that int()s the first line still reaches its geometry
+                # error path instead of a raw ValueError; whitespace
+                # split here reads both layouts
+                f.write(f"{self.num_shards}\n{self.engine}\n"
+                        .encode("ascii"))
                 self.fs.fsync(f)
 
     @staticmethod

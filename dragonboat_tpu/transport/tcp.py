@@ -269,6 +269,13 @@ class TCPTransport(ITransport):
                     # magic continues, poison is a clean close
                     pre = _recv_exact(sock, 2)
                     if pre == GO_POISON:
+                        # ack the poison (tcp.go:507 sendPoisonAck) —
+                        # a reference peer blocks in waitPoisonAck for
+                        # its deadline on every clean close otherwise
+                        try:
+                            sock.sendall(GO_POISON)
+                        except OSError:
+                            pass
                         break
                     if pre != GO_MAGIC:
                         raise ValueError("bad magic")

@@ -25,4 +25,13 @@ run tests/test_a*.py tests/test_b*.py tests/test_d*.py tests/test_e*.py \
     tests/test_f*.py tests/test_g*.py tests/test_h*.py tests/test_k*.py
 run tests/test_m*.py tests/test_n*.py tests/test_r*.py tests/test_s*.py \
     tests/test_t*.py tests/test_v*.py
+# catch-all: any test file whose first letter the chunks above do not
+# enumerate (a future test_c*/test_i*/... must not silently never run)
+leftover=$(ls tests/test_*.py | grep -v \
+    -e 'tests/test_zz_kernel_scale\.py' -e 'tests/test_zz_mesh_scale\.py' \
+    -e 'tests/test_[abdefghkmnrstv]' || true)
+if [ -n "$leftover" ]; then
+    # shellcheck disable=SC2086
+    run $leftover
+fi
 exit $rc

@@ -6,6 +6,22 @@ PERF_TPU.jsonl — so a revived tunnel is never wasted on a compile that
 outlives it.  Small shapes first: every completed rung is a recorded
 datapoint even if the tunnel dies mid-ladder.
 
+Round-5 ladder hardening (VERDICT r4 item 1 — the 4096 rung died as an
+undiagnosed "rung timeout"):
+ - every rung emits staged PROG lines (built / elected / compiled), so a
+   timeout records WHERE it died instead of nothing;
+ - a timed-out rung is retried once with a doubled budget — the
+   persistent jax compile cache means the retry skips the 10-minute
+   compile the first attempt paid for, so a mid-rung wedge can no longer
+   zero a long compile;
+ - the per-rung budget scales with G (compile time grows super-linearly
+   at big shapes).
+
+The per-rung A/B now measures the question that matters: the
+`onehot_reads` rewrite (gathers 155→36) against the dynamic-index form,
+on the hardware the lever was built for.  TPU_GRAB_VARIANT overrides
+(e.g. unroll_scans).
+
 Usage: python scripts/tpu_grab.py [--ladder 256,1024,4096,8192]
 """
 
@@ -28,49 +44,56 @@ from dragonboat_tpu.hostenv import jax_cache_dir
 jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 plat = jax.devices()[0].platform
+import dataclasses
 from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps, elect_all
+
+def prog(stage, **kw):
+    print("PROG " + json.dumps(dict(stage=stage, t=round(time.time(), 1),
+                                    **kw)), flush=True)
+
+def measure(kp, tag):
+    t0 = time.time()
+    state, box = elect_all(kp, 3, make_cluster(kp, G, 3))
+    jax.block_until_ready(state.term)
+    setup_s = time.time() - t0
+    prog("elected", tag=tag, setup_s=round(setup_s, 1))
+    t0 = time.time()
+    state, box = run_steps(kp, 3, 4, True, True, state, box)
+    jax.block_until_ready(state.term)
+    compile_s = time.time() - t0
+    prog("compiled", tag=tag, compile_s=round(compile_s, 1))
+    t0 = time.time()
+    state, box = run_steps(kp, 3, N, True, True, state, box)
+    jax.block_until_ready(state.term)
+    dt = time.time() - t0
+    return setup_s, compile_s, dt
+
 G = {g}
-kp = bench_params(3)
-t0 = time.time()
-state, box = elect_all(kp, 3, make_cluster(kp, G, 3))
-jax.block_until_ready(state.term)
-setup_s = time.time() - t0
-t0 = time.time()
-state, box = run_steps(kp, 3, 4, True, True, state, box)
-jax.block_until_ready(state.term)
-compile_s = time.time() - t0
-t0 = time.time()
 N = {steps}
-state, box = run_steps(kp, 3, N, True, True, state, box)
-jax.block_until_ready(state.term)
-dt = time.time() - t0
-wps = {g} * 28 / (dt / N)   # 28 committed writes per group-step (bench width)
+kp = bench_params(3)
+prog("start", groups=G, onehot=bool(kp.onehot_reads), platform=plat)
+setup_s, compile_s, dt = measure(kp, "plain")
+wps = G * 28 / (dt / N)   # 28 committed writes per group-step (bench width)
 rec = {{
     "ts": time.time(), "platform": plat, "groups": G,
+    "onehot_reads": bool(kp.onehot_reads),
     "setup_s": round(setup_s, 1), "compile_s": round(compile_s, 1),
     "step_ms": round(dt / N * 1000, 3), "writes_per_s": int(wps),
 }}
-# Second measurement per rung: A/B one variant against the plain kernel.
-# Default is unroll_scans (lax.scan unroll — bitwise-neutral scheduling,
-# kills the per-iteration serial launches of the family scans);
-# TPU_GRAB_MERGED=1 measures the old merge_inbox_families restructure
-# instead (44% slower on TPU at r4, kept for re-checks).
-variant = ("merge_inbox_families" if os.environ.get("TPU_GRAB_MERGED") == "1"
-           else "unroll_scans")
 # bank the plain measurement NOW: the variant costs a second compile,
 # and a wedge/timeout there must not lose the rung (the harvester takes
 # the LAST RUNG line)
 print("RUNG " + json.dumps(rec), flush=True)
+# Second measurement per rung: A/B the onehot_reads rewrite (the round's
+# open question — gathers 155->36) unless TPU_GRAB_VARIANT names another
+# static flag to flip.
+variant = os.environ.get("TPU_GRAB_VARIANT", "onehot_reads")
 try:
-    import dataclasses
-    kpm = dataclasses.replace(kp, **{{variant: True}})
-    state2, box2 = elect_all(kpm, 3, make_cluster(kpm, G, 3))
-    state2, box2 = run_steps(kpm, 3, 4, True, True, state2, box2)
-    jax.block_until_ready(state2.term)
-    t0 = time.time()
-    state2, box2 = run_steps(kpm, 3, N, True, True, state2, box2)
-    jax.block_until_ready(state2.term)
-    rec[variant + "_step_ms"] = round((time.time() - t0) / N * 1000, 3)
+    cur = getattr(kp, variant)
+    kpm = dataclasses.replace(kp, **{{variant: not cur}})
+    vtag = "%s=%s" % (variant, not cur)
+    _, _, dtv = measure(kpm, vtag)
+    rec[vtag + "_step_ms"] = round(dtv / N * 1000, 3)
 except Exception as e:   # the plain rung must survive a variant failure
     rec[variant + "_error"] = str(e)[-200:]
 print("RUNG " + json.dumps(rec))
@@ -90,6 +113,43 @@ def probe(timeout: float = 60.0) -> bool:
         return False
 
 
+def _last(out: str, prefix: str):
+    """Last parseable line with the given prefix (a kill mid-write
+    truncates the tail)."""
+    rec = None
+    for ln in out.splitlines():
+        if ln.startswith(prefix):
+            try:
+                rec = json.loads(ln[len(prefix):])
+            except ValueError:
+                pass
+    return rec
+
+
+def _run_rung(code: str, env: dict, timeout: float):
+    """One rung attempt.  Returns (rec_or_None, last_prog, timed_out)."""
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+        out = r.stdout or ""
+        err = r.stderr or ""
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        # salvage banked lines from the partial output
+        out = (e.stdout or b"")
+        out = out.decode(errors="replace") if isinstance(out, bytes) else out
+        err = "rung timeout"
+        timed_out = True
+    rec = _last(out, "RUNG ")
+    prog = _last(out, "PROG ")
+    if rec is None and not timed_out:
+        rec_err = {"error": (err or "no output")[-500:]}
+        if prog:
+            rec_err["last_stage"] = prog
+        return rec_err, prog, False
+    return rec, prog, timed_out
+
+
 def main() -> None:
     ladder = [int(x) for x in (
         sys.argv[sys.argv.index("--ladder") + 1].split(",")
@@ -104,33 +164,26 @@ def main() -> None:
         env = dict(os.environ)
         if os.environ.get("TPU_GRAB_FORCE_CPU") == "1":
             env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
-        # generous per-rung timeout: compile at new shapes is slow over
-        # the tunnel, but a wedge must not eat the whole session
-        try:
-            r = subprocess.run([sys.executable, "-c", code], env=env,
-                               capture_output=True, text=True, timeout=900)
-            out = r.stdout or ""
-            err = r.stderr or ""
-        except subprocess.TimeoutExpired as e:
-            # salvage a banked plain measurement from the partial output
-            out = (e.stdout or b"")
-            out = out.decode(errors="replace") if isinstance(out, bytes) else out
-            err = "rung timeout"
-            r = None
-        rec_parsed = None
-        for ln in out.splitlines():   # last PARSEABLE RUNG line wins (a
-            if ln.startswith("RUNG "):  # kill mid-write truncates the tail)
-                try:
-                    rec_parsed = json.loads(ln[5:])
-                except ValueError:
-                    pass
-        if rec_parsed is None:
-            rec = {"ts": time.time(), "groups": g,
-                   "error": (err or "no output")[-500:]}
-        else:
-            rec = rec_parsed
-            if r is None:   # plain banked, variant lost to the timeout
-                rec["variant_timeout"] = True
+        # compile-aware budget: compile grows super-linearly with G over
+        # the tunnel (the r4 4096 rung outlived a flat 900 s)
+        budget = 900.0 if g <= 1024 else (1800.0 if g <= 4096 else 2700.0)
+        rec, prog, timed_out = _run_rung(code, env, budget)
+        if timed_out and rec is None:
+            # the compile the first attempt paid for is in the
+            # persistent cache — a retry skips straight to measurement
+            note = {"ts": time.time(), "groups": g,
+                    "note": "first attempt timed out; retrying on warm "
+                            "cache", "last_stage": prog}
+            print(json.dumps(note), flush=True)
+            rec, prog, timed_out = _run_rung(code, env, budget * 2)
+        if rec is None:
+            rec = {"error": "rung timeout (after retry)"}
+            if prog:
+                rec["last_stage"] = prog
+        rec.setdefault("ts", time.time())
+        rec.setdefault("groups", g)
+        if timed_out:
+            rec["variant_timeout"] = True
         with open(OUT, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)

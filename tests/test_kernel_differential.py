@@ -567,60 +567,13 @@ def test_diff_witness_randomized_trace(seed):
     d.compare("witness-random-trace")
 
 
-@pytest.mark.parametrize("seed", [3, 21])
-def test_diff_merged_families_lockstep(seed):
-    """The opt-in unrolled inbox families (KernelParams
-    .merge_inbox_families — the TPU serial-segment lever) must stay
-    BITWISE identical to the scan path.  Driven kernel-vs-kernel over
-    the REAL typed router layout (bench_loop, K=10: resp/rep/hb/vote
-    slots all live — the pycore harness packs slots FIFO and would
-    leave the typed families empty): elect, then a seeded drop storm
-    (term bumps, vote tallies, leader transitions through the merged
-    pass), then a mixed read/write phase (heartbeat-resp ReadIndex
-    confirms), comparing every state leaf bitwise at each phase end."""
-    import dataclasses
-
-    import jax
-
-    from dragonboat_tpu.bench_loop import (
-        bench_params,
-        make_cluster,
-        run_steps,
-        run_steps_mixed,
-        run_steps_storm,
-        elect_all,
-    )
-
-    def drive(kp):
-        state, box = elect_all(kp, 3, make_cluster(kp, 64, 3))
-        snaps = [jax.tree_util.tree_map(np.asarray, state)]
-        state, box = run_steps_storm(kp, 3, 40, 0.25, seed, state, box)
-        snaps.append(jax.tree_util.tree_map(np.asarray, state))
-        state, box = run_steps(kp, 3, 30, True, True, state, box)
-        snaps.append(jax.tree_util.tree_map(np.asarray, state))
-        state, box, _ = run_steps_mixed(
-            kp, 3, 20, max(1, kp.proposal_cap // 8),
-            np.int32(7), state, box, np.int32(0))
-        snaps.append(jax.tree_util.tree_map(np.asarray, state))
-        return snaps
-
-    kp = bench_params(3)
-    a = drive(kp)
-    b = drive(dataclasses.replace(kp, merge_inbox_families=True))
-    for phase, (sa, sb) in enumerate(zip(a, b)):
-        for name, va, vb in zip(sa._fields, sa, sb):
-            assert np.array_equal(va, vb), \
-                f"phase {phase} field {name} diverged (seed {seed})"
-
-
 @pytest.mark.parametrize("seed", [5, 42])
 def test_diff_onehot_reads_lockstep(seed):
     """The platform-tuned read lowering (KernelParams.onehot_reads:
     one-hot select on device, dynamic indexing on CPU — kernel._get1,
     router pick/take) must stay BITWISE identical across the flag.
-    Same phase plan as the merged-families differential: elect, drop
-    storm, write load, mixed reads — every state leaf compared at each
-    phase end."""
+    Phase plan: elect, drop storm, write load, mixed reads — every
+    state leaf compared bitwise at each phase end."""
     import dataclasses
 
     import jax
@@ -664,10 +617,9 @@ def test_diff_onehot_reads_lockstep(seed):
 def test_diff_unroll_scans_lockstep(seed):
     """lax.scan unroll for the family scans (KernelParams.unroll_scans —
     the TPU serial-launch lever the ladder A/Bs) must stay BITWISE
-    identical to the rolled form.  Unlike merge_inbox_families (a hand
-    restructure), unroll= is lax.scan's own scheduling parameter with a
-    library-level equivalence contract; this test exists to catch an XLA
-    unroll miscompile, not a semantics change.  Env-gated: the unrolled
+    identical to the rolled form.  unroll= is lax.scan's own scheduling
+    parameter with a library-level equivalence contract; this test
+    exists to catch an XLA unroll miscompile, not a semantics change.  Env-gated: the unrolled
     XLA:CPU compile is pathologically slow (see skip reason) — run it
     deliberately on a box with headroom, or on TPU where compile is
     tractable, before trusting a ladder A/B that favors the unrolled
