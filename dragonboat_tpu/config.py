@@ -33,6 +33,13 @@ class Config:
     # compression envelope for snapshot files (config.CompressionType
     # Snappy analog; V3 per-block zlib in rsm/snapshotio.py)
     snapshot_compression: bool = False
+    # per-shard proposal-payload compression (config.go:161
+    # EntryCompressionType): "no-compression" (default), "snappy"
+    # (go-wire interoperable — the reference's dio snappy block), or
+    # "zlib" (repo extension: C-fast, NOT understood by Go fleets).
+    # Applied at propose time (EncodedEntry envelope, rsm/encoded.py),
+    # unwrapped at apply on every replica.
+    entry_compression: str = "no-compression"
     # TPU-native surface: run this shard as a lane of the host's batched
     # device kernel instead of a host-Python Peer (engine/kernel_engine.py)
     device_resident: bool = False
@@ -58,6 +65,14 @@ class Config:
             raise ConfigError("witness can not be a non-voting member")
         if self.max_in_mem_log_size != 0 and self.max_in_mem_log_size < 256:
             raise ConfigError("MaxInMemLogSize must be >= 256")
+        from dragonboat_tpu.rsm.encoded import COMPRESSION_TYPES
+
+        if self.entry_compression not in COMPRESSION_TYPES:
+            raise ConfigError(
+                f"unknown EntryCompressionType {self.entry_compression!r}"
+            )
+        if self.is_witness and self.entry_compression != "no-compression":
+            raise ConfigError("witness does not carry proposal payloads")
 
 
 @dataclass

@@ -133,17 +133,30 @@ class KernelNode(Node):
     def is_leader(self) -> bool:
         return self._leader_cache == self.replica_id
 
+    def read(self, timeout_ticks: int):
+        """Reads enqueue into the book WITHOUT the _post choke point
+        (no node-state mutation), so the lane must be dirtied here or
+        the staging pass would never pick the batch up — before the
+        engine-wide tick broadcast (r5), the per-tick dirty-marking of
+        every lane masked this."""
+        rs = super().read(timeout_ticks)
+        eng, lane = self.engine, self.lane
+        if eng is not None and lane >= 0:
+            eng.mark_dirty(lane)
+        return rs
+
     def tick(self) -> None:
+        """Direct per-lane tick (tests / pre-injection): the NodeHost
+        ticker never calls this for engine-registered lanes — it hands
+        the whole round to the engine as one pending broadcast
+        (KernelEngine.tick_round)."""
         self._tick_pending += 1
         eng, lane = self.engine, self.lane
         if eng is not None and lane >= 0:
             eng.mark_dirty(lane)
-        for book in (self.pending_proposals, self.pending_reads,
-                     self.pending_config_change, self.pending_snapshot,
-                     self.pending_transfer, self.pending_log_query,
-                     self.pending_compaction):
-            book.advance()
-            book.gc()
+        if self._owns_clock:
+            self._clock.advance()
+        self.gc_books()
 
     def _take_snapshot(self, req: _SnapshotRequest) -> None:
         """Snapshot for a device-resident shard: the device compacts its
@@ -282,6 +295,13 @@ class KernelEngine:
         # admissions queued for the next step's batched injection
         # (lane -> (node, init, pids, kinds)); see _flush_injections
         self._pending_inject: dict[int, tuple] = {}
+        # whole-engine tick rounds queued by the host ticker; each step
+        # consumes ONE round as a vectorized [G]-bool broadcast (the
+        # per-lane Python tick walk was ~25 s/round at 100k lanes).
+        # Capped so a long no-node idle cannot bank a burst of rounds
+        # that would fast-forward election timers on the first admission
+        self._tick_rounds_pending = 0
+        self._tick_mu = threading.Lock()
         # persistent staging buffers, zeroed per step (the jitted step
         # needs fixed [capacity] shapes anyway; reallocating every engine
         # iteration would cost ~G*K*E ints of fresh numpy per step)
@@ -520,6 +540,14 @@ class KernelEngine:
 
     # -- the step ---------------------------------------------------------
 
+    def tick_round(self) -> None:
+        """Queue one tick round for EVERY registered lane (called once
+        per host tick interval; consumed in step_all as one vectorized
+        broadcast)."""
+        with self._tick_mu:
+            if self._tick_rounds_pending < 8:
+                self._tick_rounds_pending += 1
+
     def mark_dirty(self, lane: int) -> None:
         """Flag a lane for the next staging pass.  Guarded by its own
         lock rather than engine.mu (ingress already holds node.mu, and
@@ -564,6 +592,17 @@ class KernelEngine:
             for g, n in staged:
                 if self._stage_lane(g, n, inbox, inp):
                     had_work = True
+            # consume one queued engine-wide tick round: every
+            # registered lane ticks via ONE vectorized bool write —
+            # no per-lane Python, no dirty-marking the whole batch
+            with self._tick_mu:
+                tick_round = self._tick_rounds_pending > 0
+                if tick_round:
+                    self._tick_rounds_pending -= 1
+            if tick_round:
+                lanes = np.fromiter(nodes.keys(), np.int64, len(nodes))
+                inp._tick[lanes] = True
+                had_work = True
             # an eviction while staging (InstallSnapshot; whole-GROUP on a
             # mesh engine) may remove rows staged EARLIER in this loop —
             # drop them, failing any proposals forwarded onto them so the
@@ -740,7 +779,10 @@ class KernelEngine:
                             or n.log_query_range is not None
                             or n.compaction_request_key is not None
                             or n._tick_pending)
-        if residual or n.pending_reads.peep() is not None:
+        # non-destructive batch probe: peep() here would move the batch
+        # under a fresh ctx that nothing ever stages — its readers would
+        # sit in pending until the timeout GC fires
+        if residual or n.pending_reads.batching:
             self._dirty.add(g)
         return work
 

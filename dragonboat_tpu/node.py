@@ -8,6 +8,7 @@ unit of work (stepNode/handleEvents → getUpdate → process, node.go:1139+).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from dataclasses import dataclass, field
@@ -22,8 +23,10 @@ from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.logdb.logreader import LogReader
 from dragonboat_tpu.logger import get_logger
 from dragonboat_tpu.quiesce import QuiesceState
+from dragonboat_tpu.rsm import encoded
 from dragonboat_tpu.raftio import EntryInfo, ILogDB, LeaderInfo, SnapshotInfo
 from dragonboat_tpu.request import (
+    LogicalClock,
     PendingProposal,
     PendingReadIndex,
     PendingSingleton,
@@ -60,6 +63,7 @@ class Node:
         events: EventHub | None = None,
         fs=None,
         worker_id: int = 0,
+        clock=None,
     ) -> None:
         from dragonboat_tpu.vfs import default_fs
 
@@ -79,12 +83,18 @@ class Node:
         self.mu = threading.RLock()
         self.log_reader = LogReader(cfg.shard_id, cfg.replica_id, logdb)
 
-        self.pending_proposals = PendingProposal()
-        self.pending_reads = PendingReadIndex()
-        self.pending_config_change = PendingSingleton()
-        self.pending_snapshot = PendingSingleton()
-        self.pending_transfer = PendingSingleton()
-        self.pending_log_query = PendingSingleton()
+        # ONE logical clock for every book: the host ticker advances it
+        # once per round (a per-book advance walk is O(lanes) Python at
+        # 100k shards); a standalone Node keeps a private clock that
+        # tick() advances itself
+        self._clock = clock if clock is not None else LogicalClock()
+        self._owns_clock = clock is None
+        self.pending_proposals = PendingProposal(clock=self._clock)
+        self.pending_reads = PendingReadIndex(clock=self._clock)
+        self.pending_config_change = PendingSingleton(clock=self._clock)
+        self.pending_snapshot = PendingSingleton(clock=self._clock)
+        self.pending_transfer = PendingSingleton(clock=self._clock)
+        self.pending_log_query = PendingSingleton(clock=self._clock)
 
         self.incoming_msgs: list[pb.Message] = []
         self.incoming_proposals: list[pb.Entry] = []
@@ -93,7 +103,7 @@ class Node:
         self.snapshot_request: _SnapshotRequest | None = None
         self.log_query_range: tuple[int, int, int] | None = None
         self.compaction_request_key: int | None = None
-        self.pending_compaction = PendingSingleton()
+        self.pending_compaction = PendingSingleton(clock=self._clock)
 
         # quiesce bookkeeping (quiesce.go:24, node.go:195)
         self.qs = QuiesceState(
@@ -288,6 +298,18 @@ class Node:
                 timeout_ticks: int) -> RequestState:
         self._check_ingress()
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        if cmd and self.cfg.entry_compression != "no-compression":
+            # EncodedEntry envelope at propose time (request.go:1094;
+            # unwrapped at apply by rsm/encoded.get_payload on every
+            # replica).  Deliberate difference: the reference wraps
+            # non-empty payloads even with compression off (1-byte
+            # header); here the default config keeps plain APPLICATION
+            # entries — both directions of a mixed Go/TPU fleet handle
+            # either type, and the uncompressed wire stays byte-stable
+            # for existing deployments.
+            entry = dataclasses.replace(
+                entry, type=pb.EntryType.ENCODED,
+                cmd=encoded.get_encoded(self.cfg.entry_compression, cmd))
         if self.rate_limiter.enabled():
             sz = pb.entry_size(entry)
             self.rate_limiter.increase(sz)
@@ -374,11 +396,20 @@ class Node:
         with self.mu:
             self.incoming_msgs.append(
                 pb.Message(type=pb.MessageType.LOCAL_TICK))
+        # a host-owned clock is advanced once per round by the ticker;
+        # a standalone node advances its private clock here
+        if self._owns_clock:
+            self._clock.advance()
+        self.gc_books()
+
+    def gc_books(self) -> None:
+        """Fire request timeouts against the absolute clock (each gc is
+        a no-op fast path when the book is empty — the host sweeps all
+        lanes' books on an amortized cadence)."""
         for book in (self.pending_proposals, self.pending_reads,
                      self.pending_config_change, self.pending_snapshot,
                      self.pending_transfer, self.pending_log_query,
                      self.pending_compaction):
-            book.advance()
             book.gc()
 
     # -- the step (engine unit of work; node.go:1139 stepNode) -------------

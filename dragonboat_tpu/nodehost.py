@@ -30,6 +30,7 @@ from dragonboat_tpu.node import Node, _SnapshotRequest
 from dragonboat_tpu.raftio import ILogDB, NodeInfo, SnapshotInfo
 from dragonboat_tpu.registry import Registry
 from dragonboat_tpu.request import (
+    LogicalClock,
     RequestDroppedError,
     RequestError,
     RequestRejectedError,
@@ -151,6 +152,12 @@ class NodeHost:
         )
         self.mu = threading.RLock()
         self.nodes: dict[int, Node] = {}
+        # ONE logical clock for every node's request books — advanced
+        # once per tick round by the ticker (absolute deadline stamps;
+        # the per-lane per-book advance walk was the 100k election
+        # pump's dominant cost, PERF.md)
+        self.logical_clock = LogicalClock()
+        self._tick_round_no = 0
         self.chunk_sink = ChunkSink(
             snapshot_dir=f"/tmp/dragonboat_tpu/{self.id}/incoming",
             deployment_id=nhconfig.deployment_id,
@@ -323,7 +330,8 @@ class NodeHost:
                 node_cls = KernelNode
             node = node_cls(cfg, self.logdb, sm, self._send_message,
                             snapshot_dir, events=self.events, fs=self.fs,
-                            worker_id=cfg.shard_id % self._num_workers)
+                            worker_id=cfg.shard_id % self._num_workers,
+                            clock=self.logical_clock)
             node.membership_changed_cb = (
                 lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc)
             )
@@ -496,7 +504,8 @@ class NodeHost:
                 return  # stopped/replaced concurrently — do not resurrect
         node = Node(cfg, self.logdb, knode.sm, self._send_message,
                     knode.snapshot_dir, events=self.events, fs=self.fs,
-                    worker_id=cfg.shard_id % self._num_workers)
+                    worker_id=cfg.shard_id % self._num_workers,
+                    clock=self.logical_clock)
         node.membership_changed_cb = (
             lambda cc, sid=cfg.shard_id: self._on_membership_change(sid, cc))
         node.stream_snapshot_cb = self._stream_snapshot
@@ -549,10 +558,7 @@ class NodeHost:
             now = time.monotonic()
             if now - last_tick >= self._tick_interval:
                 last_tick = now
-                with self.mu:
-                    nodes = list(self.nodes.values())
-                for n in nodes:
-                    n.tick()
+                self._do_tick_round()
                 self.chunk_sink.tick()
             for ev in self._worker_events:
                 ev.set()
@@ -639,12 +645,35 @@ class NodeHost:
         for ev in self._worker_events:
             ev.set()
 
-    def tick_all(self) -> None:
-        """Manual tick for auto_run=False test drivers."""
+    def _do_tick_round(self, sweep_every: int = 8) -> None:
+        """One tick round: advance the shared clock ONCE, tick the
+        host-resident nodes, and hand engine-registered lanes to their
+        engine as a single pending round (consumed as one vectorized
+        [G]-bool broadcast at the next device step).  Per-lane Python
+        here was the 100k election pump's wall clock (~25 s/round);
+        request-timeout GC over engine lanes is an amortized sweep
+        (books compare absolute deadline stamps, so skipped rounds
+        cannot drift the deadline — only delay its firing by at most
+        ``sweep_every`` rounds)."""
+        self.logical_clock.advance()
+        self._tick_round_no += 1
+        sweep = (self._tick_round_no % sweep_every) == 0
         with self.mu:
             nodes = list(self.nodes.values())
         for n in nodes:
+            if getattr(n, "engine", None) is not None and n.lane >= 0:
+                if sweep:
+                    n.gc_books()
+                continue
             n.tick()
+        for eng in (self.kernel_engine, self.mesh_engine):
+            if eng is not None:
+                eng.tick_round()
+
+    def tick_all(self) -> None:
+        """Manual tick for auto_run=False test drivers (books GC every
+        round — deterministic timeouts for tests)."""
+        self._do_tick_round(sweep_every=1)
 
     def _stream_snapshot(self, node: Node, m: pb.Message) -> None:
         """Live-stream an on-disk SM's snapshot to a lagging peer

@@ -32,6 +32,7 @@ traced from.
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 from typing import Sequence
 
@@ -612,3 +613,147 @@ def decode_message_batch(data) -> tuple[
         else:
             i = _skip_field(mv, i, wire)
     return tuple(msgs), dep, src, ver
+
+
+# --------------------------------------------------------------------------
+# Chunk (chunk.go:44-146 MarshalTo): the snapshot-stream record a Go
+# fleet ships on its snapshot connections.  Same unconditional-emit
+# framing as the other gogo records; note there is NO field 11.
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GoChunk:
+    """The reference's pb.Chunk, reference field layout (chunk.go:11-31).
+    Deliberately distinct from the repo's own ``raftpb.Chunk`` (native
+    wire: concatenated stream + embedded chunk-0 message) — the Go wire
+    splits PER FILE and synthesizes the InstallSnapshot receiver-side."""
+
+    shard_id: int = 0
+    replica_id: int = 0          # target
+    from_: int = 0               # sender replica
+    chunk_id: int = 0
+    chunk_size: int = 0
+    chunk_count: int = 0
+    data: bytes = b""
+    index: int = 0
+    term: int = 0
+    membership: pb.Membership = dataclasses.field(
+        default_factory=pb.Membership)
+    filepath: str = ""
+    file_size: int = 0
+    deployment_id: int = 0
+    file_chunk_id: int = 0
+    file_chunk_count: int = 0
+    has_file_info: bool = False
+    file_info: pb.SnapshotFile = dataclasses.field(
+        default_factory=lambda: pb.SnapshotFile(file_id=0, filepath=""))
+    bin_ver: int = 1
+    on_disk_index: int = 0
+    witness: bool = False
+
+    def is_last(self) -> bool:
+        return self.chunk_id == self.chunk_count - 1
+
+
+def encode_chunk(c: GoChunk) -> bytes:
+    out = bytearray()
+    _tag(out, 1, 0)
+    _uvarint(out, c.shard_id)
+    _tag(out, 2, 0)
+    _uvarint(out, c.replica_id)
+    _tag(out, 3, 0)
+    _uvarint(out, c.from_)
+    _tag(out, 4, 0)
+    _uvarint(out, c.chunk_id)
+    _tag(out, 5, 0)
+    _uvarint(out, c.chunk_size)
+    _tag(out, 6, 0)
+    _uvarint(out, c.chunk_count)
+    if c.data:
+        _tag(out, 7, 2)
+        _bytes(out, c.data)
+    _tag(out, 8, 0)
+    _uvarint(out, c.index)
+    _tag(out, 9, 0)
+    _uvarint(out, c.term)
+    _tag(out, 10, 2)
+    _bytes(out, encode_membership(c.membership))
+    _tag(out, 12, 2)
+    _bytes(out, c.filepath.encode())
+    _tag(out, 13, 0)
+    _uvarint(out, c.file_size)
+    _tag(out, 14, 0)
+    _uvarint(out, c.deployment_id)
+    _tag(out, 15, 0)
+    _uvarint(out, c.file_chunk_id)
+    _tag(out, 16, 0)
+    _uvarint(out, c.file_chunk_count)
+    _tag(out, 17, 0)
+    _bool(out, c.has_file_info)
+    _tag(out, 18, 2)
+    _bytes(out, encode_snapshot_file(c.file_info))
+    _tag(out, 19, 0)
+    _uvarint(out, c.bin_ver)
+    _tag(out, 20, 0)
+    _uvarint(out, c.on_disk_index)
+    _tag(out, 21, 0)
+    _bool(out, c.witness)
+    return bytes(out)
+
+
+def decode_chunk(data) -> GoChunk:
+    mv = memoryview(data)
+    i = 0
+    kw: dict = {}
+    while i < len(mv):
+        key, i = _read_uvarint(mv, i)
+        field, wire = key >> 3, key & 7
+        if field == 1 and wire == 0:
+            kw["shard_id"], i = _read_uvarint(mv, i)
+        elif field == 2 and wire == 0:
+            kw["replica_id"], i = _read_uvarint(mv, i)
+        elif field == 3 and wire == 0:
+            kw["from_"], i = _read_uvarint(mv, i)
+        elif field == 4 and wire == 0:
+            kw["chunk_id"], i = _read_uvarint(mv, i)
+        elif field == 5 and wire == 0:
+            kw["chunk_size"], i = _read_uvarint(mv, i)
+        elif field == 6 and wire == 0:
+            kw["chunk_count"], i = _read_uvarint(mv, i)
+        elif field == 7 and wire == 2:
+            kw["data"], i = _read_bytes(mv, i)
+        elif field == 8 and wire == 0:
+            kw["index"], i = _read_uvarint(mv, i)
+        elif field == 9 and wire == 0:
+            kw["term"], i = _read_uvarint(mv, i)
+        elif field == 10 and wire == 2:
+            b, i = _read_bytes(mv, i)
+            kw["membership"] = decode_membership(b)
+        elif field == 12 and wire == 2:
+            b, i = _read_bytes(mv, i)
+            kw["filepath"] = b.decode()
+        elif field == 13 and wire == 0:
+            kw["file_size"], i = _read_uvarint(mv, i)
+        elif field == 14 and wire == 0:
+            kw["deployment_id"], i = _read_uvarint(mv, i)
+        elif field == 15 and wire == 0:
+            kw["file_chunk_id"], i = _read_uvarint(mv, i)
+        elif field == 16 and wire == 0:
+            kw["file_chunk_count"], i = _read_uvarint(mv, i)
+        elif field == 17 and wire == 0:
+            v, i = _read_uvarint(mv, i)
+            kw["has_file_info"] = bool(v)
+        elif field == 18 and wire == 2:
+            b, i = _read_bytes(mv, i)
+            kw["file_info"] = decode_snapshot_file(b)
+        elif field == 19 and wire == 0:
+            kw["bin_ver"], i = _read_uvarint(mv, i)
+        elif field == 20 and wire == 0:
+            kw["on_disk_index"], i = _read_uvarint(mv, i)
+        elif field == 21 and wire == 0:
+            v, i = _read_uvarint(mv, i)
+            kw["witness"] = bool(v)
+        else:
+            i = _skip_field(mv, i, wire)
+    return GoChunk(**kw)

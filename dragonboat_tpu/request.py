@@ -98,15 +98,38 @@ class RequestState:
         raise RequestError(f"request failed: {r.code}")
 
 
-class _ClockedBook:
-    """Shared timeout machinery (logicalClock ticks)."""
+class LogicalClock:
+    """One absolute tick counter shared by every request book of a host
+    (request.go:236 logicalClock).  The host ticker advances it ONCE per
+    tick round; books stamp deadlines against it and compare absolutely
+    — the per-book per-lane ``advance()`` walk this replaces was the
+    dominant cost of the 100k-lane election pump (~25 s/tick-round of
+    pure Python increments, PERF.md)."""
+
+    __slots__ = ("tick",)
 
     def __init__(self) -> None:
-        self.mu = threading.Lock()
         self.tick = 0
 
     def advance(self) -> None:
         self.tick += 1
+
+
+class _ClockedBook:
+    """Timeout machinery against a (possibly shared) LogicalClock."""
+
+    def __init__(self, clock: LogicalClock | None = None) -> None:
+        self.mu = threading.Lock()
+        self.clock = clock if clock is not None else LogicalClock()
+
+    @property
+    def tick(self) -> int:
+        return self.clock.tick
+
+    def advance(self) -> None:
+        """Standalone-book compatibility (tests construct books without
+        a host); hosts advance the SHARED clock once per round instead."""
+        self.clock.advance()
 
 
 class PendingProposal(_ClockedBook):
@@ -121,8 +144,9 @@ class PendingProposal(_ClockedBook):
 
     _seq = itertools.count(1)
 
-    def __init__(self, shards: int = 8) -> None:
-        super().__init__()
+    def __init__(self, shards: int = 8,
+                 clock: LogicalClock | None = None) -> None:
+        super().__init__(clock)
         self._shards: list[dict[int, RequestState]] = [
             {} for _ in range(shards)]
         self._locks = [threading.Lock() for _ in range(shards)]
@@ -177,6 +201,11 @@ class PendingProposal(_ClockedBook):
             rs.notify(RequestResult(code=RequestResultCode.DROPPED))
 
     def gc(self) -> None:
+        # unlocked emptiness fast path: the amortized host sweep calls
+        # gc on EVERY lane's books; an entry racing in is caught by the
+        # next sweep (timeouts are tick-granular anyway)
+        if not any(self._shards):
+            return
         for i in range(self._n):
             with self._locks[i]:
                 d = self._shards[i]
@@ -201,8 +230,8 @@ class PendingReadIndex(_ClockedBook):
 
     _ctx = itertools.count(1)
 
-    def __init__(self) -> None:
-        super().__init__()
+    def __init__(self, clock: LogicalClock | None = None) -> None:
+        super().__init__(clock)
         self.pending: dict[int, list[RequestState]] = {}   # ctx_low -> readers
         self.batching: list[RequestState] = []
         self.ready: dict[int, int] = {}                    # ctx_low -> index
@@ -252,6 +281,10 @@ class PendingReadIndex(_ClockedBook):
             rs.notify(RequestResult(code=RequestResultCode.DROPPED))
 
     def gc(self) -> None:
+        # unlocked fast path (racy-but-benign: a concurrent add is
+        # caught by the next sweep)
+        if not (self.batching or self.waiting or self.pending):
+            return
         with self.mu:
             def expire(lst):
                 live, dead = [], []
@@ -262,8 +295,24 @@ class PendingReadIndex(_ClockedBook):
 
             self.batching, dead1 = expire(self.batching)
             self.waiting, dead2 = expire(self.waiting)
+            # readers parked under an in-flight ctx (peep() issued, the
+            # quorum round lost to a leader change that never reported
+            # the ctx back) must still time out — request.go's
+            # pendingReadIndex gc scans its pending batches the same way
+            dead3 = []
+            for ctx_low, readers in list(self.pending.items()):
+                live = [rs for rs in readers
+                        if rs.deadline_tick > self.tick]
+                dead3 += [rs for rs in readers
+                          if rs.deadline_tick <= self.tick]
+                if live:
+                    self.pending[ctx_low] = live
+                else:
+                    del self.pending[ctx_low]
         for item in dead1 + dead2:
             rs = item[1] if isinstance(item, tuple) else item
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+        for rs in dead3:
             rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
 
     def terminate_all(self) -> None:
@@ -280,8 +329,8 @@ class PendingSingleton(_ClockedBook):
     """One-in-flight book for config change / snapshot / transfer
     (request.go:549-570)."""
 
-    def __init__(self) -> None:
-        super().__init__()
+    def __init__(self, clock: LogicalClock | None = None) -> None:
+        super().__init__(clock)
         self.key_seq = itertools.count(1)
         self.outstanding: RequestState | None = None
         self.key = 0
@@ -306,6 +355,8 @@ class PendingSingleton(_ClockedBook):
                                 snapshot_index=snapshot_index))
 
     def gc(self) -> None:
+        if self.outstanding is None:              # unlocked fast path
+            return
         with self.mu:
             rs = self.outstanding
             if rs is not None and rs.deadline_tick <= self.tick:

@@ -20,6 +20,7 @@ from typing import Callable, Sequence
 
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu import statemachine as sm_api
+from dragonboat_tpu.rsm.encoded import get_payload
 from dragonboat_tpu.rsm.membership import MembershipStore
 from dragonboat_tpu.rsm.session import LRUSession
 from dragonboat_tpu.rsm.snapshotio import (
@@ -177,7 +178,7 @@ class StateMachine:
         return res
 
     def _update(self, e: pb.Entry) -> sm_api.Result:
-        entry = sm_api.Entry(index=e.index, cmd=e.cmd)
+        entry = sm_api.Entry(index=e.index, cmd=get_payload(e))
         if self.sm_type == pb.StateMachineType.REGULAR:
             return self.sm.update(entry)
         results = self.sm.update([entry])

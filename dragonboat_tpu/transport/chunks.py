@@ -124,10 +124,26 @@ class ChunkSink:
         self.max_concurrent = max_concurrent
         self.mu = threading.Lock()
         self.transfers: dict[tuple[int, int, int], _Transfer] = {}
+        # reference-layout chunks (go-wire transports) reassemble under
+        # their own semantics; shares dir/deliver/GC with this sink
+        self._go: "GoChunkSink | None" = None
 
-    def add(self, c: pb.Chunk) -> bool:
+    def add(self, c) -> bool:
         """Returns False when the chunk is rejected (out of order, over the
-        concurrency cap, wrong deployment)."""
+        concurrency cap, wrong deployment).  Dispatches reference-layout
+        ``gowire.GoChunk`` records (no embedded message, per-file split)
+        to the go-wire reassembler."""
+        if not isinstance(c, pb.Chunk):
+            if self._go is None:
+                # construct under the lock: one reader thread per inbound
+                # connection — two concurrent first-chunks must not each
+                # build a sink and orphan the loser's open transfer
+                with self.mu:
+                    if self._go is None:
+                        self._go = GoChunkSink(
+                            self.dir, self.deployment_id, self.deliver,
+                            self.max_concurrent)
+            return self._go.add(c)
         if c.deployment_id != self.deployment_id:
             return False
         key = (c.shard_id, c.replica_id, c.from_)
@@ -223,6 +239,237 @@ class ChunkSink:
 
     def tick(self) -> None:
         """Advance the GC clock; drop stalled transfers (chunk.go GC)."""
+        with self.mu:
+            stalled = []
+            for key, t in self.transfers.items():
+                t.idle_ticks += 1
+                if t.idle_ticks >= GC_TICKS:
+                    stalled.append(key)
+            for key in stalled:
+                self._abort_locked(key)
+        if self._go is not None:
+            self._go.tick()
+
+    def inflight(self) -> int:
+        with self.mu:
+            n = len(self.transfers)
+        return n + (self._go.inflight() if self._go is not None else 0)
+
+
+# ---------------------------------------------------------------------------
+# Go-wire snapshot streaming (snapshot.go getChunks / chunk.go Add): the
+# reference splits PER FILE (each chunk names its file and carries
+# file_chunk_id/count alongside the global chunk_id/count) and the
+# RECEIVER synthesizes the InstallSnapshot message from chunk fields —
+# there is no embedded chunk-0 message on this wire.  The local on-disk
+# layout of a reassembled transfer stays the repo's own (incoming-*.gbsnap
+# + .xfN external files, same as the native sink) — only the WIRE format
+# must match the Go fleet.
+# ---------------------------------------------------------------------------
+
+
+def split_snapshot_message_go(m: pb.Message, deployment_id: int,
+                              chunk_size: int = SNAPSHOT_CHUNK_SIZE):
+    """Yield reference-layout GoChunks for an InstallSnapshot
+    (snapshot.go:204 getChunks + :225 loadChunkData read-at-send).
+    Witness snapshots are refused: the repo's witnesses never stream
+    (config.validate bars witness snapshots), and synthesizing the
+    reference's witness image (rsm.GetWitnessSnapshot) is out of scope."""
+    from dragonboat_tpu.raftpb import gowire
+
+    ss = m.snapshot
+    if ss.witness:
+        raise ValueError("witness snapshot streaming on the go wire "
+                         "is not supported")
+    files: list[tuple[str, int, pb.SnapshotFile | None]] = []
+    main_size = os.path.getsize(ss.filepath) if ss.filepath else 0
+    if main_size == 0:
+        raise ValueError("empty snapshot file")  # snapshot.go:208 panic
+    files.append((ss.filepath, main_size, None))
+    for sf in ss.files:
+        files.append((sf.filepath, sf.file_size, sf))
+    per_file = [max(1, (sz + chunk_size - 1) // chunk_size)
+                for _, sz, _ in files]
+    total = sum(per_file)
+    chunk_id = 0
+    for (path, size, sf), count in zip(files, per_file):
+        with open(path, "rb") as f:
+            for fcid in range(count):
+                data = f.read(chunk_size)
+                yield gowire.GoChunk(
+                    shard_id=m.shard_id,
+                    replica_id=m.to,
+                    from_=m.from_,
+                    chunk_id=chunk_id,
+                    chunk_count=total,
+                    chunk_size=len(data),
+                    data=data,
+                    index=ss.index,
+                    term=ss.term,
+                    membership=ss.membership,
+                    filepath=path,
+                    file_size=size,
+                    deployment_id=deployment_id,
+                    file_chunk_id=fcid,
+                    file_chunk_count=count,
+                    has_file_info=sf is not None,
+                    file_info=sf if sf is not None else pb.SnapshotFile(
+                        file_id=0, filepath=""),
+                    on_disk_index=ss.on_disk_index,
+                    witness=ss.witness,
+                )
+                chunk_id += 1
+
+
+@dataclass
+class _GoTransfer:
+    next_chunk: int = 0
+    path: str = ""                      # container file
+    fh: object = None
+    idle_ticks: int = 0
+    main_written: int = 0
+    files: list = field(default_factory=list)   # (SnapshotFile, local path)
+    cur_file_fh: object = None
+    cur_file_written: int = 0
+    first: object = None                # chunk 0 (message fields)
+
+
+class GoChunkSink:
+    """Receiver reassembly for reference-layout chunks (chunk.go:106
+    Add): strict global ordering, per-file writes, and the final
+    InstallSnapshot synthesized from chunk fields (chunk.go toMessage).
+    Shares the native sink's directory, delivery callback and GC
+    cadence — ``ChunkSink`` owns one and dispatches by chunk type."""
+
+    def __init__(self, snapshot_dir: str, deployment_id: int, deliver,
+                 max_concurrent: int = MAX_CONCURRENT_STREAMS):
+        self.dir = snapshot_dir
+        self.deployment_id = deployment_id
+        self.deliver = deliver
+        self.max_concurrent = max_concurrent
+        self.mu = threading.Lock()
+        self.transfers: dict[tuple[int, int, int], _GoTransfer] = {}
+
+    def add(self, c) -> bool:
+        if c.deployment_id != self.deployment_id:
+            return False
+        if c.witness:
+            return False                 # symmetric with the send refusal
+        key = (c.shard_id, c.replica_id, c.from_)
+        completed = None
+        with self.mu:
+            t = self.transfers.get(key)
+            if c.chunk_id == 0:
+                if t is not None:
+                    self._abort_locked(key)
+                if len(self.transfers) >= self.max_concurrent:
+                    return False
+                os.makedirs(self.dir, exist_ok=True)
+                path = os.path.join(
+                    self.dir,
+                    f"incoming-{c.shard_id:016X}-{c.replica_id:016X}"
+                    f"-{c.index:016X}.gbsnap",
+                )
+                t = _GoTransfer(path=path, fh=open(path, "wb"), first=c)
+                self.transfers[key] = t
+            elif t is None or c.chunk_id != t.next_chunk:
+                if t is not None:
+                    self._abort_locked(key)
+                return False
+            t.idle_ticks = 0
+            t.next_chunk = c.chunk_id + 1
+            if not c.has_file_info:
+                if t.fh is None:     # main file already closed: protocol
+                    self._abort_locked(key)   # violation, clean reject
+                    return False
+                t.fh.write(c.data)
+                t.main_written += len(c.data)
+                if c.file_chunk_id == c.file_chunk_count - 1:
+                    t.fh.close()
+                    if t.main_written != c.file_size:
+                        self._abort_locked(key)
+                        return False
+                    t.fh = None
+            else:
+                if c.file_chunk_id == 0:
+                    if t.cur_file_fh is not None:   # protocol violation
+                        self._abort_locked(key)
+                        return False
+                    dst = f"{t.path}.xf{c.file_info.file_id}"
+                    t.cur_file_fh = open(dst, "wb")
+                    t.cur_file_written = 0
+                    t.files.append((c.file_info, dst))
+                if t.cur_file_fh is None:
+                    self._abort_locked(key)
+                    return False
+                t.cur_file_fh.write(c.data)
+                t.cur_file_written += len(c.data)
+                if c.file_chunk_id == c.file_chunk_count - 1:
+                    t.cur_file_fh.close()
+                    t.cur_file_fh = None
+                    if t.cur_file_written != c.file_size:
+                        self._abort_locked(key)
+                        return False
+            if c.is_last():
+                if t.fh is not None or t.cur_file_fh is not None:
+                    self._abort_locked(key)   # a file never closed
+                    return False
+                del self.transfers[key]
+                completed = t
+        if completed is not None:
+            self.deliver(self._to_message(completed), "")
+        return True
+
+    @staticmethod
+    def _to_message(t: _GoTransfer) -> pb.Message:
+        """chunk.go toMessage: rebuild the InstallSnapshot from the
+        chunk fields, filepaths rewritten to the reassembled local
+        files."""
+        from dataclasses import replace
+
+        c0 = t.first
+        files = tuple(replace(sf, filepath=dst) for sf, dst in t.files)
+        ss = pb.Snapshot(
+            filepath=t.path,
+            file_size=t.main_written,
+            index=c0.index,
+            term=c0.term,
+            membership=c0.membership,
+            files=files,
+            shard_id=c0.shard_id,
+            on_disk_index=c0.on_disk_index,
+            witness=c0.witness,
+        )
+        # term stays 0 (chunk.go toMessage sets no Term): a zero-term
+        # message bypasses the staleness gate (raft.go
+        # onMessageTermNotMatched / pycore.py:858) — the snapshot's own
+        # term rides in ss.term; the sender's message term never crossed
+        # this wire
+        return pb.Message(
+            type=pb.MessageType.INSTALL_SNAPSHOT,
+            to=c0.replica_id,
+            from_=c0.from_,
+            shard_id=c0.shard_id,
+            snapshot=ss,
+        )
+
+    def _abort_locked(self, key) -> None:
+        t = self.transfers.pop(key, None)
+        if t is None:
+            return
+        for fh in (t.fh, t.cur_file_fh):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        for p in [t.path] + [dst for _, dst in t.files]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def tick(self) -> None:
         with self.mu:
             stalled = []
             for key, t in self.transfers.items():

@@ -176,12 +176,24 @@ class TransportHub:
         runs snapshot sends in a dedicated job pool (snapshot.go:211,
         job.go:43-69); blocking the engine thread here would stall every
         shard's ticks for the duration of a transfer."""
-        from dragonboat_tpu.transport.chunks import split_snapshot_message
+        from dragonboat_tpu.transport.chunks import (
+            split_snapshot_message,
+            split_snapshot_message_go,
+        )
+
+        # the transport picks the chunk layout: go-wire fleets speak the
+        # reference's per-file Chunk records (no embedded message);
+        # everything else ships the native concatenated stream
+        go_wire = getattr(self.transport, "wire", "native") == "go"
 
         def job() -> None:
-            self.send_snapshot_chunks(
-                m, split_snapshot_message(m, self.deployment_id,
-                                          source_address=self.source_address))
+            if go_wire:
+                chunks = split_snapshot_message_go(m, self.deployment_id)
+            else:
+                chunks = split_snapshot_message(
+                    m, self.deployment_id,
+                    source_address=self.source_address)
+            self.send_snapshot_chunks(m, chunks)
 
         threading.Thread(target=job, name="snapshot-stream",
                          daemon=True).start()

@@ -113,14 +113,23 @@ class _TCPConn:
                 _send_frame(self.sock, RAFT_TYPE,
                             pb.encode_message_batch(batch))
 
-    def send_chunk(self, chunk: pb.Chunk) -> None:
+    def send_chunk(self, chunk) -> None:
         if self.wire == "go":
-            # descope (documented): go-wire mode carries raft traffic;
-            # snapshot streaming between heterogeneous fleets goes
-            # through export/import (tools.py), not the chunk stream
-            raise NotImplementedError(
-                "go-wire snapshot streaming is out of scope; "
-                "use export/import across fleets")
+            # reference snapshot framing (tcp.go:373): the same
+            # magic+header preamble with method=200 per chunk, payload
+            # a gogo-marshaled pb.Chunk (gowire.GoChunk here — the hub
+            # splits with split_snapshot_message_go on this wire)
+            from dragonboat_tpu.raftpb import gowire
+
+            if not isinstance(chunk, gowire.GoChunk):
+                raise TypeError(
+                    "go-wire transport sends gowire.GoChunk records")
+            payload = gowire.encode_chunk(chunk)
+            with self.mu:
+                self.sock.sendall(GO_MAGIC +
+                                  _encode_header(SNAPSHOT_TYPE, payload) +
+                                  payload)
+            return
         with self.mu:
             _send_frame(self.sock, SNAPSHOT_TYPE, pb.encode_chunk(chunk))
 
@@ -152,14 +161,6 @@ class _ConnProxy(IConnection):
             m = chunk.get("message")
             raise ValueError("tcp transport requires pb.Chunk, got dict "
                              f"(message={m is not None})")
-        if self.transport.wire == "go":
-            # reject BEFORE the connection path: routing the descope
-            # error through _call would evict the healthy shared raft
-            # connection and feed the per-address breaker on every
-            # InstallSnapshot retry
-            raise NotImplementedError(
-                "go-wire snapshot streaming is out of scope; "
-                "use export/import across fleets")
         self._call("send_chunk", chunk)
 
 
@@ -285,11 +286,14 @@ class TCPTransport(ITransport):
                 if zlib.crc32(payload) != pcrc:
                     raise ValueError("payload crc mismatch")
                 if method == SNAPSHOT_TYPE and self.wire == "go":
-                    # symmetric with the send-side descope: a reference
-                    # peer's chunk stream is rejected explicitly, not fed
-                    # to the native chunk codec
-                    raise ValueError(
-                        "snapshot stream on the go wire is out of scope")
+                    # a reference peer's snapshot stream: decode the
+                    # gogo-marshaled Chunk and hand it to the chunk
+                    # sink's go-wire reassembler (ChunkSink.add
+                    # dispatches on the record type)
+                    from dragonboat_tpu.raftpb import gowire
+
+                    self.chunk_handler(gowire.decode_chunk(payload))
+                    continue
                 if method == RAFT_TYPE:
                     if self.wire == "go":
                         from dragonboat_tpu.raftpb import gowire
@@ -366,8 +370,12 @@ class TCPTransportFactory:
     byte format — the 2-byte magic preamble + 18-byte crc'd request
     header (tcp.go:43,64-110) around a gogo-protobuf MessageBatch
     (raftpb/gowire.py) — so a host can exchange raft traffic with
-    reference hosts over DCN.  Snapshot streaming in go mode is a
-    documented descope (export/import crosses fleets)."""
+    reference hosts over DCN.  Snapshot streaming interops too: method
+    200 requests carry reference-layout Chunks both ways (gowire
+    GoChunk + chunks.py split_snapshot_message_go/GoChunkSink), so a
+    lagging member on either side heals in-band.  The one residual
+    descope is witness-snapshot streaming (both sides refuse; the
+    repo's witnesses never take snapshots)."""
 
     def __init__(self, wire: str = "native") -> None:
         self.wire = wire

@@ -30,6 +30,18 @@ if "--groups" in sys.argv:
 STEPS = int(os.environ.get("SCALE_STEPS", "5"))
 
 
+def _enable_compile_cache() -> None:
+    """Persistent compile cache keyed at capacity shapes: the 100k-lane
+    step executable compiled once per box (the r4 measurement paid a
+    479 s first-step compile on every run)."""
+    import jax
+
+    from dragonboat_tpu.hostenv import jax_cache_dir
+
+    jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def rss_gb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
@@ -37,6 +49,8 @@ def rss_gb() -> float:
 def phase_a() -> None:
     import jax
     import jax.numpy as jnp
+
+    _enable_compile_cache()
 
     from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps
     from dragonboat_tpu.core.kstate import empty_inbox
@@ -97,6 +111,7 @@ def phase_b() -> None:
         def recover_from_snapshot(self, r, files, done):
             r.read(4)
 
+    _enable_compile_cache()
     expert = ExpertConfig()
     expert.kernel_capacity = GROUPS
     # no node_host_dir -> MemLogDB: the measurement targets the host
@@ -133,10 +148,10 @@ def phase_b() -> None:
     eng = nh.kernel_engine
 
     def tick_all():
-        with nh.mu:
-            nodes = list(nh.nodes.values())
-        for n in nodes:
-            n.tick()
+        # the PRODUCTION tick round: one shared-clock advance + one
+        # engine-wide pending tick (consumed as a vectorized broadcast
+        # at the next step) — not a per-lane Python walk
+        nh._do_tick_round()
 
     # first kernel call: flushes EVERY queued injection at once AND
     # compiles the step executable at this capacity

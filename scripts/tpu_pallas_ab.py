@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""On-device A/B of the rsm-apply kernels (VERDICT r4 item 8: the pallas
+kernel has been bit-exact in interpret mode for two rounds; its reason
+to exist is a compiled device number).
+
+Measures, at the bench shape (sm_params, direct-mapped table):
+
+  1. the bare apply kernels on a synthetic [G, AB] committed window —
+     sequential probing scan vs one-pass range apply vs the pallas
+     block kernel (VMEM-resident table across the window);
+  2. the full device-SM step loop (run_steps_sm) with the XLA range
+     apply vs the pallas apply.
+
+Appends one JSON line (kind=pallas_ab) to PERF_TPU.jsonl.  Self-test on
+CPU with PALLAS_AB_FORCE_CPU=1 (pallas runs in interpret mode there —
+the relative number is meaningless off-TPU, the plumbing check is not).
+
+Usage: python scripts/tpu_pallas_ab.py [groups]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from dragonboat_tpu.hostenv import jax_cache_dir
+
+jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+OUT = os.path.join(REPO, "PERF_TPU.jsonl")
+
+
+def bare_apply_ab(G: int, AB: int, iters: int = 50) -> dict:
+    """Apply kernels alone on synthetic windows (no raft step around
+    them): per-call ms for scan/range/pallas at [G, AB]."""
+    import numpy as np
+
+    from dragonboat_tpu.rsm.device_kv import DeviceKV
+    from dragonboat_tpu.rsm.device_kv_pallas import apply_kernel_pallas
+
+    kv = DeviceKV(table_cap=1024, hash_keys=False)
+    T = kv.table_cap
+    rng = np.random.default_rng(3)
+    first = jnp.asarray(rng.integers(0, T, G), jnp.int32)
+    vals = jnp.asarray(rng.integers(1, 1 << 20, (G, AB)), jnp.int32)
+    valid = jnp.asarray(rng.random((G, AB)) < 0.9)
+    idx = first[:, None] + jnp.arange(AB, dtype=jnp.int32)[None, :]
+    keys = idx & (T - 1)
+    cmds = jnp.stack([keys, vals], axis=-1)
+
+    out = {}
+
+    def timed(tag, fn):
+        st = kv.init_state(G)
+        st, _ = fn(st)                      # compile
+        jax.block_until_ready(st["vals"])
+        t0 = time.time()
+        for _ in range(iters):
+            st, _ = fn(st)
+        jax.block_until_ready(st["vals"])
+        out[tag + "_ms"] = round((time.time() - t0) / iters * 1e3, 3)
+
+    timed("apply_scan", lambda st: kv.apply_kernel(st, cmds, valid))
+    timed("apply_range",
+          lambda st: kv.apply_kernel_range(st, first & (T - 1), vals, valid))
+    try:
+        timed("apply_pallas",
+              lambda st: apply_kernel_pallas(kv, st, cmds, valid))
+    except Exception as e:
+        out["apply_pallas_error"] = str(e)[-200:]
+    return out
+
+
+def step_loop_ab(G: int, steps: int) -> dict:
+    """run_steps_sm with the range apply vs the pallas apply — the
+    number that decides which one full_step_sm ships."""
+    from dragonboat_tpu.bench_loop import (
+        elect_all,
+        make_cluster,
+        make_device_sm,
+        run_steps_sm,
+        sm_params,
+    )
+
+    kp = sm_params(3)
+    out = {}
+    for tag, use_pallas in (("sm_range", False), ("sm_pallas", True)):
+        try:
+            state, box = elect_all(kp, 3, make_cluster(kp, G, 3))
+            kv, kv_state = make_device_sm(G, 3, use_pallas=use_pallas)
+            state, box, kv_state, _ = run_steps_sm(
+                kp, 3, kv, 4, True, True, state, box, kv_state)  # compile
+            jax.block_until_ready(state.term)
+            t0 = time.time()
+            state, box, kv_state, _ = run_steps_sm(
+                kp, 3, kv, steps, True, True, state, box, kv_state)
+            jax.block_until_ready(state.term)
+            out[tag + "_step_ms"] = round(
+                (time.time() - t0) / steps * 1e3, 3)
+        except Exception as e:
+            out[tag + "_error"] = str(e)[-200:]
+    return out
+
+
+def main() -> None:
+    g = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
+        else 1024
+    plat = jax.devices()[0].platform
+    if plat == "cpu" and os.environ.get("PALLAS_AB_FORCE_CPU") != "1":
+        print(json.dumps({"skipped": "cpu backend (interpret-mode pallas "
+                                     "measures nothing); set "
+                                     "PALLAS_AB_FORCE_CPU=1 to self-test"}))
+        return
+    rec = {"ts": time.time(), "kind": "pallas_ab", "platform": plat,
+           "groups": g}
+    from dragonboat_tpu.bench_loop import sm_params
+
+    AB = sm_params(3).apply_batch
+    print(f"backend: {plat}  groups: {g}  AB: {AB}", flush=True)
+    rec.update(bare_apply_ab(g * 3, AB))
+    print("bare: " + json.dumps(rec), flush=True)
+    rec.update(step_loop_ab(g, steps=max(10, min(50, 100_000 // g))))
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,8 @@ while true; do
         # kernel; big rungs amortize it
         timeout 3700 python scripts/tpu_grab.py --ladder 64,1024,4096,8192 \
             >> "$LOG" 2>&1
+        # the pallas rsm-apply verdict (compiled, not interpret mode)
+        timeout 1200 python scripts/tpu_pallas_ab.py 1024 >> "$LOG" 2>&1
         # the scoreboard itself: a full bench on device (provisional
         # lines survive a mid-run wedge)
         timeout 3000 python "$REPO/bench.py" \
