@@ -621,6 +621,11 @@ def decode_message_batch(data) -> tuple[
 # framing as the other gogo records; note there is NO field 11.
 # --------------------------------------------------------------------------
 
+# raft.go:256-261: streamed transfers don't know their total up front —
+# the tail chunk carries the LastChunkCount sentinel instead
+LAST_CHUNK_COUNT = (1 << 64) - 1
+POISON_CHUNK_COUNT = (1 << 64) - 2
+
 
 @dataclasses.dataclass(frozen=True)
 class GoChunk:
@@ -653,7 +658,17 @@ class GoChunk:
     witness: bool = False
 
     def is_last(self) -> bool:
-        return self.chunk_id == self.chunk_count - 1
+        # IsLastChunk (raft.go:267): counted transfers end at
+        # chunk_count == chunk_id+1; streamed ones at the sentinel
+        return (self.chunk_count == LAST_CHUNK_COUNT
+                or self.chunk_count == self.chunk_id + 1)
+
+    def is_last_file_chunk(self) -> bool:
+        # IsLastFileChunk (raft.go:273)
+        return self.file_chunk_id + 1 == self.file_chunk_count
+
+    def is_poison(self) -> bool:
+        return self.chunk_count == POISON_CHUNK_COUNT
 
 
 def encode_chunk(c: GoChunk) -> bytes:

@@ -22,6 +22,8 @@ including the Go fleet's — accepts.
 
 from __future__ import annotations
 
+from dragonboat_tpu import raftpb as pb
+
 EE_HEADER_SIZE = 1
 EE_V0 = 0 << 4
 EE_NO_COMPRESSION = 0 << 1
@@ -99,19 +101,24 @@ def _emit_copy2(out: bytearray, offset: int, length: int) -> None:
 
 
 def snappy_block_encode(data: bytes) -> bytes:
-    """Greedy hash-match encoder: 4-byte anchors, 16-bit offsets."""
+    """Greedy hash-match encoder: 4-byte anchors hashed into a FIXED
+    16K-slot position table (the golang/snappy shape — O(1) memory at
+    any payload size; a dict keyed by raw 4-byte slices costs ~100x the
+    input in transient allocations), matches verified by comparison,
+    16-bit offsets, copy-2 elements."""
     if len(data) > MAX_PAYLOAD:
         raise ValueError("snappy: payload too large")
     out = bytearray()
     _put_uvarint(out, len(data))
     n = len(data)
     i = lit_start = 0
-    table: dict[bytes, int] = {}
+    table = [0] * (1 << 14)               # position+1; 0 = empty slot
     while i + 4 <= n:
-        seq = data[i:i + 4]
-        j = table.get(seq, -1)
-        table[seq] = i
-        if 0 <= j and i - j < (1 << 16):
+        v = int.from_bytes(data[i:i + 4], "little")
+        h = ((v * 0x1E35A7BD) & 0xFFFFFFFF) >> 18
+        j = table[h] - 1
+        table[h] = i + 1
+        if 0 <= j and i - j < (1 << 16) and data[j:j + 4] == data[i:i + 4]:
             length = 4
             while (i + length < n and length < (1 << 24)
                    and data[j + length] == data[i + length]):
@@ -206,8 +213,6 @@ def get_payload(entry) -> bytes:
     """The payload ready for the state machine (GetPayload,
     encoded.go:54): ENCODED entries are unwrapped, everything else
     passes through."""
-    from dragonboat_tpu import raftpb as pb
-
     if entry.type != pb.EntryType.ENCODED:
         return entry.cmd
     cmd = entry.cmd

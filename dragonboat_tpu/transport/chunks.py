@@ -356,6 +356,12 @@ class GoChunkSink:
         if c.witness:
             return False                 # symmetric with the send refusal
         key = (c.shard_id, c.replica_id, c.from_)
+        if c.is_poison():
+            # a failed sender poisons its stream (raftpb LastChunkCount-1,
+            # job.go): drop the transfer, nothing to deliver
+            with self.mu:
+                self._abort_locked(key)
+            return False
         completed = None
         with self.mu:
             t = self.transfers.get(key)
@@ -384,12 +390,17 @@ class GoChunkSink:
                     return False
                 t.fh.write(c.data)
                 t.main_written += len(c.data)
-                if c.file_chunk_id == c.file_chunk_count - 1:
+                # counted transfers close the main file on its last file
+                # chunk; STREAMED ones (rsm.ChunkWriter — file_chunk
+                # counts are 0 / the LastChunkCount sentinel) only at
+                # the stream tail.  file_size is unknown for streams
+                # (0): size validation applies to counted files only.
+                if c.is_last_file_chunk() or c.is_last():
                     t.fh.close()
-                    if t.main_written != c.file_size:
+                    t.fh = None
+                    if c.file_size and t.main_written != c.file_size:
                         self._abort_locked(key)
                         return False
-                    t.fh = None
             else:
                 if c.file_chunk_id == 0:
                     if t.cur_file_fh is not None:   # protocol violation
@@ -404,7 +415,7 @@ class GoChunkSink:
                     return False
                 t.cur_file_fh.write(c.data)
                 t.cur_file_written += len(c.data)
-                if c.file_chunk_id == c.file_chunk_count - 1:
+                if c.is_last_file_chunk():
                     t.cur_file_fh.close()
                     t.cur_file_fh = None
                     if t.cur_file_written != c.file_size:
@@ -482,3 +493,36 @@ class GoChunkSink:
     def inflight(self) -> int:
         with self.mu:
             return len(self.transfers)
+
+
+def native_chunk_to_go(c: pb.Chunk):
+    """Adapt one NATIVE streamed chunk (rsm/chunkwriter.py — chunk 0
+    carries the InstallSnapshot message; the tail carries
+    chunk_count=id+1 + total file_size) to the reference layout, so an
+    on-disk SM's live stream interops with a Go receiver: membership /
+    on_disk_index ride every reference chunk from the chunk-0 message,
+    and the filepath is the reference's snapshot filename convention
+    (server.GetSnapshotFilename — the receiver re-bases it locally
+    anyway)."""
+    from dragonboat_tpu.raftpb import gowire
+
+    ss = c.message.snapshot if c.message is not None else None
+    return gowire.GoChunk(
+        shard_id=c.shard_id,
+        replica_id=c.replica_id,
+        from_=c.from_,
+        chunk_id=c.chunk_id,
+        chunk_size=c.chunk_size,
+        chunk_count=c.chunk_count,
+        data=c.data,
+        index=c.index,
+        term=c.term,
+        membership=ss.membership if ss is not None else pb.Membership(),
+        filepath=f"snapshot-{c.index:016X}.gbsnap",
+        file_size=c.file_size,
+        deployment_id=c.deployment_id,
+        file_chunk_id=c.chunk_id,
+        file_chunk_count=c.chunk_count,
+        on_disk_index=ss.on_disk_index if ss is not None else 0,
+        witness=ss.witness if ss is not None else False,
+    )

@@ -185,6 +185,13 @@ class TransportHub:
         # reference's per-file Chunk records (no embedded message);
         # everything else ships the native concatenated stream
         go_wire = getattr(self.transport, "wire", "native") == "go"
+        if go_wire and m.snapshot.witness:
+            # documented go-wire descope: refuse CLEANLY here — letting
+            # the splitter raise inside the send job would b.fail() the
+            # address breaker on every raft retry until it opens and
+            # drops ALL traffic to that host, not just this stream
+            self._notify_snapshot_failed(m)
+            return False
 
         def job() -> None:
             if go_wire:
@@ -200,7 +207,16 @@ class TransportHub:
         return True
 
     def send_snapshot_chunks(self, m: pb.Message, chunks) -> bool:
-        """Send an InstallSnapshot as a chunk stream (snapshot.go:211)."""
+        """Send an InstallSnapshot as a chunk stream (snapshot.go:211).
+        On a go-wire transport, NATIVE chunks (the on-disk SM live
+        stream, rsm/chunkwriter.py) are adapted to the reference layout
+        per chunk — file-based sends arrive here already split by
+        split_snapshot_message_go."""
+        if getattr(self.transport, "wire", "native") == "go":
+            from dragonboat_tpu.transport.chunks import native_chunk_to_go
+
+            chunks = (native_chunk_to_go(c) if isinstance(c, pb.Chunk)
+                      else c for c in chunks)
         try:
             addr, _ = self.resolver.resolve(m.shard_id, m.to)
         except KeyError:

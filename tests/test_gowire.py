@@ -277,3 +277,51 @@ def test_unknown_fields_skipped():
     extra += bytes([0xA0, 0x06, 0x2A])
     s = gw.decode_state(bytes(extra))
     assert s == pb.State(term=1, vote=2, commit=3)
+
+
+def test_oracle_chunk_both_directions():
+    """The go-wire Chunk codec against the protoc oracle BOTH ways: a
+    field-number or wire-type mistake mirrored in encode_chunk and
+    decode_chunk passes a self-roundtrip but not this — and the real
+    counterparty is an untested Go fleet."""
+    from dragonboat_tpu.raftpb import gowire
+
+    po = _oracle()
+    c = gowire.GoChunk(
+        shard_id=5, replica_id=2, from_=1, chunk_id=3, chunk_size=4,
+        chunk_count=9, data=b"abcd", index=42, term=7,
+        membership=pb.Membership(config_change_id=6,
+                                 addresses={1: "a:1", 2: "b:2"},
+                                 witnesses={3: "w:3"}),
+        filepath="snapshot-000000000000002A.gbsnap", file_size=4096,
+        deployment_id=11, file_chunk_id=3, file_chunk_count=9,
+        has_file_info=True,
+        file_info=pb.SnapshotFile(file_id=4, filepath="ext.bin",
+                                  file_size=100, metadata=b"m"),
+        bin_ver=1, on_disk_index=40, witness=False)
+    raw = gowire.encode_chunk(c)
+    oc = po.Chunk()
+    oc.ParseFromString(raw)
+    assert oc.shard_id == 5 and oc.replica_id == 2
+    assert getattr(oc, "from") == 1
+    assert (oc.chunk_id, oc.chunk_size, oc.chunk_count) == (3, 4, 9)
+    assert oc.data == b"abcd" and oc.index == 42 and oc.term == 7
+    assert oc.membership.addresses[1] == "a:1"
+    assert oc.membership.witnesses[3] == "w:3"
+    assert oc.filepath == "snapshot-000000000000002A.gbsnap"
+    assert (oc.file_size, oc.deployment_id) == (4096, 11)
+    assert (oc.file_chunk_id, oc.file_chunk_count) == (3, 9)
+    assert oc.has_file_info and oc.file_info.file_id == 4
+    assert oc.file_info.filepath == "ext.bin" and oc.file_info.metadata == b"m"
+    assert oc.bin_ver == 1 and oc.on_disk_index == 40 and not oc.witness
+
+    # oracle-encoded bytes decode to the same record (gogo emits only
+    # non-default fields; the decoder must tolerate the sparse form)
+    oc2 = po.Chunk(shard_id=3, replica_id=1, **{"from": 2}, chunk_id=1,
+                   chunk_size=2, chunk_count=(1 << 64) - 1, data=b"xy",
+                   index=8, term=3, filepath="f", file_size=5,
+                   deployment_id=1)
+    g2 = gowire.decode_chunk(oc2.SerializeToString())
+    assert (g2.shard_id, g2.replica_id, g2.from_) == (3, 1, 2)
+    assert g2.data == b"xy" and g2.chunk_id == 1
+    assert g2.chunk_count == gowire.LAST_CHUNK_COUNT and g2.is_last()

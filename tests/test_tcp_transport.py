@@ -516,3 +516,68 @@ def test_go_chunk_sink_rejects(tmp_path):
     for c in chunks:
         assert sink2.add(c)
     assert len(done) == 1
+
+
+def test_tcp_ondisk_live_stream_go_wire(monkeypatch):
+    """On-disk SM live stream over the reference byte format: the
+    native ChunkWriter chunks are adapted per chunk (hub
+    native_chunk_to_go) and reassembled by the go-wire sink's
+    streamed-tail rules — the second interop shape (chunkwriter.go
+    LastChunkCount-style streams) after the file-based catchup above."""
+    from dragonboat_tpu.rsm.statemachine import StateMachine
+    from test_snapshot_stream import DiskKV
+
+    calls = {"n": 0}
+    orig = StateMachine.stream_snapshot
+
+    def counting(self, w, on_meta=None):
+        calls["n"] += 1
+        return orig(self, w, on_meta=on_meta)
+
+    monkeypatch.setattr(StateMachine, "stream_snapshot", counting)
+
+    ports = free_ports(3)
+    addrs = {i: f"127.0.0.1:{ports[i - 1]}" for i in (1, 2, 3)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(
+            raft_address=addr, rtt_millisecond=5,
+            transport_factory=TCPTransportFactory(wire="go")))
+        nh.start_replica(addrs, False, DiskKV, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[rid] = nh
+    try:
+        lid = _leader(hosts)
+        lagger = next(r for r in hosts if r != lid)
+        hosts[lagger].close()
+        stopped = hosts.pop(lagger)
+        s = hosts[lid].get_noop_session(1)
+        for i in range(30):
+            hosts[lid].sync_propose(s, f"d{i}=v{i}".encode())
+        addr = stopped.config.raft_address
+        nh2 = None
+        for _ in range(50):
+            try:
+                nh2 = NodeHost(NodeHostConfig(
+                    raft_address=addr, rtt_millisecond=5,
+                    transport_factory=TCPTransportFactory(wire="go")))
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert nh2 is not None
+        a2 = {r: h.config.raft_address for r, h in hosts.items()}
+        a2[lagger] = addr
+        nh2.start_replica(a2, False, DiskKV, Config(
+            shard_id=1, replica_id=lagger, election_rtt=10, heartbeat_rtt=1,
+            snapshot_entries=6, compaction_overhead=2))
+        hosts[lagger] = nh2
+        deadline = time.time() + 20
+        while time.time() < deadline and nh2.stale_read(1, "d29") != "v29":
+            time.sleep(0.05)
+        assert nh2.stale_read(1, "d29") == "v29", \
+            "on-disk lagger never caught up over the go-wire live stream"
+        assert calls["n"] >= 1, "the live-stream path was never exercised"
+    finally:
+        for h in hosts.values():
+            h.close()
