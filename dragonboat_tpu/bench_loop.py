@@ -271,12 +271,17 @@ def run_steps_mixed_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
     VALUES into the carry so the lookups are live computation XLA cannot
     elide; ``rejects`` accumulates across calls like the other carries.  The read pass is slot-scan shaped ([G, T] compare/select —
     each table slot tests whether it falls in the served window) rather
-    than a batched gather, for the same reason as kernel._get1.
-    Direct-mapped tables only (key == slot is what makes the slot scan
-    exact)."""
+    than a batched gather, for the same reason as kernel._get1.  Works
+    on BOTH table kinds: direct-mapped slots test their own position;
+    hashed slots test their STORED key (open addressing keeps keys
+    unique per table, so a served key hits at most one slot).  The
+    bench default stays direct-mapped because raft applies a contiguous
+    index window — the range apply exploits exactly that; the hashed
+    probing apply would measure the hash scheme, not the mix
+    (equivalence across kinds: tests/test_bench_modes.py)."""
     assert kp.inline_payloads, "device-SM path needs sm_params()"
-    assert not kv.hash_keys, "served-read slot scan needs direct mapping"
     T = kv.table_cap
+    KS = T // 2 if kv.hash_keys else T      # key space (device_kv.py)
     CAP, AB = kp.log_cap, kp.apply_batch
     RB = 9 * write_width
 
@@ -291,8 +296,14 @@ def run_steps_mixed_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
         idx = out.apply_first[:, None] + jnp.arange(AB, dtype=I32)[None, :]
         valid = idx <= out.apply_last[:, None]
         vals = jnp.take_along_axis(st.lv, idx & (CAP - 1), axis=1)
-        first_key = out.apply_first & (T - 1)
-        ks, (_res, ok) = kv.apply_kernel_range(ks, first_key, vals, valid)
+        if kv.hash_keys:
+            keys = idx & (KS - 1)
+            cmds = jnp.stack([keys, vals], axis=-1)
+            ks, (_res, ok) = kv.apply_kernel(ks, cmds, valid)
+        else:
+            first_key = out.apply_first & (T - 1)
+            ks, (_res, ok) = kv.apply_kernel_range(ks, first_key, vals,
+                                                   valid)
         rej = rej + jnp.sum(~ok & valid)
         # read side: serve the newest confirmed ctx per lane — RB keys
         # directly below the ctx index, read slot-scan style.  ReadIndex
@@ -301,8 +312,15 @@ def run_steps_mixed_sm(kp: KP.KernelParams, replicas: int, kv, iters: int,
         # unservable ctx is dropped from the count, never served stale
         rix = jnp.max(jnp.where(out.rtr_valid, out.rtr_index, 0), axis=1)
         served = jnp.any(out.rtr_valid, axis=1) & (rix <= st.processed)
-        d = (rix[:, None] - 1 - jnp.arange(T, dtype=I32)[None, :]) & (T - 1)
-        hit = (d < RB) & served[:, None]
+        if kv.hash_keys:
+            # stored key (keys-1; 0 = empty sentinel) tested against the
+            # served key window, modulo the key space
+            d = (rix[:, None] - 1 - (ks["keys"] - 1)) & (KS - 1)
+            hit = (d < RB) & (ks["keys"] > 0) & served[:, None]
+        else:
+            d = ((rix[:, None] - 1
+                  - jnp.arange(T, dtype=I32)[None, :]) & (T - 1))
+            hit = (d < RB) & served[:, None]
         ac = ac + jnp.sum(jnp.where(hit, ks["vals"], 0))
         rd = rd + jnp.sum(served.astype(I32))
         return st, bx, ks, rd, ac, rej

@@ -625,6 +625,10 @@ def decode_message_batch(data) -> tuple[
 # the tail chunk carries the LastChunkCount sentinel instead
 LAST_CHUNK_COUNT = (1 << 64) - 1
 POISON_CHUNK_COUNT = (1 << 64) - 2
+# raftio/binversion.go:30: the reference REJECTS received batches and
+# chunks whose BinVer differs (transport.go:312, chunk.go:108) — every
+# outbound go-wire record must stamp this
+TRANSPORT_BIN_VERSION = 210
 
 
 @dataclasses.dataclass(frozen=True)
@@ -653,7 +657,7 @@ class GoChunk:
     has_file_info: bool = False
     file_info: pb.SnapshotFile = dataclasses.field(
         default_factory=lambda: pb.SnapshotFile(file_id=0, filepath=""))
-    bin_ver: int = 1
+    bin_ver: int = TRANSPORT_BIN_VERSION
     on_disk_index: int = 0
     witness: bool = False
 

@@ -112,10 +112,17 @@ def snappy_block_encode(data: bytes) -> bytes:
     _put_uvarint(out, len(data))
     n = len(data)
     i = lit_start = 0
-    table = [0] * (1 << 14)               # position+1; 0 = empty slot
+    # table sized to the input (golang/snappy: grow from 256 toward 16K
+    # while smaller than the payload) — a sub-KB proposal must not pay
+    # a 16K-slot zero-fill per call on the propose hot path
+    table_size, shift = 256, 24
+    while table_size < (1 << 14) and table_size < n:
+        table_size <<= 1
+        shift -= 1
+    table = [0] * table_size              # position+1; 0 = empty slot
     while i + 4 <= n:
         v = int.from_bytes(data[i:i + 4], "little")
-        h = ((v * 0x1E35A7BD) & 0xFFFFFFFF) >> 18
+        h = ((v * 0x1E35A7BD) & 0xFFFFFFFF) >> shift
         j = table[h] - 1
         table[h] = i + 1
         if 0 <= j and i - j < (1 << 16) and data[j:j + 4] == data[i:i + 4]:

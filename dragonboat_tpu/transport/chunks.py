@@ -495,18 +495,35 @@ class GoChunkSink:
             return len(self.transfers)
 
 
-def native_chunk_to_go(c: pb.Chunk):
+def adapt_native_chunks_to_go(chunks):
+    """Adapt a NATIVE chunk stream to reference-layout GoChunks,
+    remembering the chunk-0 snapshot so membership / on_disk_index /
+    witness are stamped on EVERY chunk the way the reference's
+    ChunkWriter does (chunkwriter.go getChunk) — receivers read chunk 0,
+    but the per-chunk fields keep the byte stream reference-shaped.
+    Already-adapted GoChunks pass through."""
+    meta = None
+    for c in chunks:
+        if not isinstance(c, pb.Chunk):
+            yield c
+            continue
+        if c.message is not None:
+            meta = c.message.snapshot
+        yield native_chunk_to_go(c, meta)
+
+
+def native_chunk_to_go(c: pb.Chunk, ss: "pb.Snapshot | None" = None):
     """Adapt one NATIVE streamed chunk (rsm/chunkwriter.py — chunk 0
     carries the InstallSnapshot message; the tail carries
-    chunk_count=id+1 + total file_size) to the reference layout, so an
-    on-disk SM's live stream interops with a Go receiver: membership /
-    on_disk_index ride every reference chunk from the chunk-0 message,
-    and the filepath is the reference's snapshot filename convention
-    (server.GetSnapshotFilename — the receiver re-bases it locally
-    anyway)."""
+    chunk_count=id+1 + total file_size) to the reference layout.
+    ``ss`` is the stream's snapshot meta (threaded from chunk 0 by
+    adapt_native_chunks_to_go); the filepath is the reference's
+    snapshot filename convention (server.GetSnapshotFilename — the
+    receiver re-bases it locally anyway)."""
     from dragonboat_tpu.raftpb import gowire
 
-    ss = c.message.snapshot if c.message is not None else None
+    if ss is None and c.message is not None:
+        ss = c.message.snapshot
     return gowire.GoChunk(
         shard_id=c.shard_id,
         replica_id=c.replica_id,

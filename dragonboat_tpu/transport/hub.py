@@ -213,10 +213,11 @@ class TransportHub:
         per chunk — file-based sends arrive here already split by
         split_snapshot_message_go."""
         if getattr(self.transport, "wire", "native") == "go":
-            from dragonboat_tpu.transport.chunks import native_chunk_to_go
+            from dragonboat_tpu.transport.chunks import (
+                adapt_native_chunks_to_go,
+            )
 
-            chunks = (native_chunk_to_go(c) if isinstance(c, pb.Chunk)
-                      else c for c in chunks)
+            chunks = adapt_native_chunks_to_go(chunks)
         try:
             addr, _ = self.resolver.resolve(m.shard_id, m.to)
         except KeyError:

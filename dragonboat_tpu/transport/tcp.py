@@ -103,7 +103,11 @@ class _TCPConn:
 
                 payload = gowire.encode_message_batch(
                     batch.requests, batch.deployment_id,
-                    batch.source_address, batch.bin_ver)
+                    batch.source_address,
+                    # a real Go receiver REJECTS BinVer != 210
+                    # (transport.go:312); the hub builds batches with
+                    # the default 0
+                    batch.bin_ver or gowire.TRANSPORT_BIN_VERSION)
                 # one buffer, one syscall: with TCP_NODELAY a separate
                 # magic write would emit its own 2-byte segment per batch
                 self.sock.sendall(GO_MAGIC +

@@ -160,3 +160,41 @@ def test_mixed_sm_read_gate_respects_apply_cursor():
     # and the cursor really did lag: committed far ahead of processed
     lag = (np.asarray(state.committed) - np.asarray(state.processed))
     assert int(lag.max()) > 10
+
+
+def test_mixed_sm_served_reads_hashed_equals_direct():
+    """The hashed-table slot scan (stored-key window test) serves the
+    SAME reads as the direct-mapped form: identical cluster trajectory,
+    identical served-ctx count AND identical read-value checksum —
+    payload values are keyed by entry index in both layouts, so any
+    divergence means one of the scans served the wrong slots."""
+    from dragonboat_tpu.bench_loop import (
+        make_device_sm,
+        run_steps_mixed_sm,
+        sm_params,
+    )
+    from dragonboat_tpu.rsm.device_kv import DeviceKV
+
+    kp = sm_params(3)
+    results = {}
+    for kind, hash_keys in (("direct", False), ("hashed", True)):
+        state = make_cluster(kp, 8, 3)
+        state, box = elect_all(kp, 3, state)
+        if hash_keys:
+            kv = DeviceKV(table_cap=1024, hash_keys=True)
+            kv_state = kv.init_state(8 * 3)
+        else:
+            kv, kv_state = make_device_sm(8, 3)
+        rd = jnp.asarray(0, jnp.int32)
+        acc = jnp.asarray(0, jnp.int32)
+        rej = jnp.asarray(0, jnp.int32)
+        state, box, kv_state, rd, acc, rej = run_steps_mixed_sm(
+            kp, 3, kv, 25, 4, jnp.asarray(0, jnp.int32),
+            state, box, kv_state, rd, acc, rej)
+        results[kind] = (int(np.asarray(rd)), int(np.asarray(acc)),
+                         int(np.asarray(rej)))
+    assert results["direct"][0] > 0
+    assert results["direct"][2] == 0 and results["hashed"][2] == 0
+    # same trajectory, same served windows, same values -> same numbers
+    assert results["hashed"][0] == results["direct"][0], results
+    assert results["hashed"][1] == results["direct"][1], results
