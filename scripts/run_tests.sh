@@ -1,17 +1,15 @@
 #!/bin/sh
 # Full test suite in three file-chunked processes.
 #
-# Why not one `pytest tests/`: on this 1-core box, a single process that
-# has executed ~300 tests crashes inside XLA:CPU's compile/deserialize
-# path (SIGABRT in compilation-cache load or SIGSEGV in
-# backend_compile, always in an engine thread) when it next touches a
-# jitted engine executable.  Four full-run reproductions on 2026-07-31
-# all died this way at a late collection position, while every file
-# subset — including the exact crash-position test — passes in a fresh
-# process, with identical code and a warm cache.  Deep engine-thread
-# stacks and cross-engine first-compile serialization (both now in the
-# product) narrowed but did not remove it; chunking bounds process age
-# instead.  Exit status is non-zero if any chunk fails.
+# History: a single `pytest tests/` used to die after ~300 tests inside
+# XLA:CPU compile/deserialize (SIGABRT/SIGSEGV).  ROOT-CAUSED r5
+# (PERF.md): vm.max_map_count exhaustion — jitted executables pin
+# mmap'd segments and the suite compiles hundreds of geometries; the
+# map count crossed 65,530 at exactly the crash position.
+# tests/conftest.py now fences it (jax.clear_caches() above 45k maps),
+# and one-process runs survive (361 passed, fence fired 37x, 2026-07-31).
+# Chunking is kept as belt+braces for CI determinism on slow boxes.
+# Exit status is non-zero if any chunk fails.
 set -e
 cd "$(dirname "$0")/.."
 PY="${PYTHON:-python}"

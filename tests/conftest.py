@@ -56,7 +56,7 @@ _RETRY_MODULES = (
     "test_nodehost", "test_node_ops", "test_tcp_transport", "test_gossip",
     "test_durable_nodehost", "test_monkey", "test_vfs",
     "test_snapshot_stream", "test_kernel_engine", "test_tools",
-    "test_history",
+    "test_history", "test_tan", "test_encoded", "test_examples",
 )
 
 
