@@ -1,0 +1,150 @@
+"""The reference's V2 snapshot container format (write + validate).
+
+A Go receiver validates EVERY snapshot chunk stream against this layout
+(chunk.go:214 -> rsm.NewSnapshotValidator), so anything the go wire
+ships as a snapshot image must be bytes a Go fleet accepts.  Layout
+(internal/rsm/snapshotio.go saveHeader + rwv.go BlockWriter):
+
+    [ header region: 1024 bytes                                   ]
+      u64 LE header_len | SnapshotHeader protobuf | zero padding
+    [ payload blocks: <=2 MiB each, 4-byte CRC32-IEEE appended    ]
+    [ tail: u64 LE total_block_bytes | 8-byte magic               ]
+
+SnapshotHeader (raftpb/snapshotheader.go MarshalTo): session_size(1),
+data_store_size(2), unreliable_time(3), git_version(4, unconditional),
+header_checksum(5, emitted once computed), payload_checksum(6),
+checksum_type(7), version(8), compression_type(9).  HeaderChecksum is
+the CRC32 of the header marshaled WITHOUT it; PayloadChecksum is the
+CRC32 of the concatenated block CRCs (rwv.go processNewBlock feeds fh).
+
+Used today for the witness image (GetWitnessSnapshot parity — payload
+is the reference's empty LRU session bank: u64 LE 4096 | u64 LE 0);
+``validate_v2`` reimplements the reference's v2validator so tests can
+prove emitted bytes pass the exact algorithm a Go receiver runs.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+HEADER_SIZE = 1024                        # settings.SnapshotHeaderSize
+BLOCK_SIZE = 2 * 1024 * 1024              # settings.SnapshotChunkSize
+CHECKSUM_SIZE = 4
+TAIL_SIZE = 16
+MAGIC = bytes([0x3F, 0x5B, 0xCB, 0xF1, 0xFA, 0xBA, 0x81, 0x9F])
+LRU_MAX_SESSION_COUNT = 4096              # settings hard default
+V2 = 2
+CRC32IEEE = 0
+NO_COMPRESSION = 0
+
+
+def _uvarint(out: bytearray, x: int) -> None:
+    while x >= 0x80:
+        out.append((x & 0x7F) | 0x80)
+        x >>= 7
+    out.append(x)
+
+
+def _marshal_header(unreliable_time: int, payload_checksum: bytes,
+                    header_checksum: bytes | None) -> bytes:
+    """snapshotheader.go MarshalTo — unconditional scalar emit, the two
+    checksum fields only when present."""
+    out = bytearray()
+    out.append(0x08)
+    _uvarint(out, 0)                      # session_size (writer leaves 0)
+    out.append(0x10)
+    _uvarint(out, 0)                      # data_store_size
+    out.append(0x18)
+    _uvarint(out, unreliable_time)
+    out.append(0x22)
+    _uvarint(out, 0)                      # git_version: empty string
+    if header_checksum is not None:
+        out.append(0x2A)
+        _uvarint(out, len(header_checksum))
+        out += header_checksum
+    out.append(0x32)
+    _uvarint(out, len(payload_checksum))
+    out += payload_checksum
+    out.append(0x38)
+    _uvarint(out, CRC32IEEE)              # checksum_type
+    out.append(0x40)
+    _uvarint(out, V2)                     # version
+    out.append(0x48)
+    _uvarint(out, NO_COMPRESSION)         # compression_type
+    return bytes(out)
+
+
+def write_v2(payload: bytes, unreliable_time: int = 1) -> bytes:
+    """The complete container for ``payload`` (block split + CRCs + tail
+    + header), as newSnapshotWriter/Close produce it."""
+    blocks = bytearray()
+    crc_cat = bytearray()                 # fh: concatenated block CRCs
+    total = 0
+    for off in range(0, len(payload), BLOCK_SIZE):
+        block = payload[off:off + BLOCK_SIZE]
+        crc = struct.pack("<I", zlib.crc32(block))
+        blocks += block + crc
+        crc_cat += crc
+        total += len(block) + CHECKSUM_SIZE
+    if not payload:                       # Close flushes even empty
+        pass
+    tail = struct.pack("<Q", total) + MAGIC
+    payload_checksum = struct.pack("<I", zlib.crc32(bytes(crc_cat)))
+    # HeaderChecksum: CRC32 of the header marshaled WITHOUT it
+    pre = _marshal_header(unreliable_time, payload_checksum, None)
+    hc = struct.pack("<I", zlib.crc32(pre))
+    hdr = _marshal_header(unreliable_time, payload_checksum, hc)
+    if len(hdr) > HEADER_SIZE - 8:
+        raise ValueError("snapshot header too large")
+    region = struct.pack("<Q", len(hdr)) + hdr
+    region += bytes(HEADER_SIZE - len(region))
+    return region + bytes(blocks) + tail
+
+
+def empty_lru_session() -> bytes:
+    """rsm.GetEmptyLRUSession: max count + zero sessions."""
+    return struct.pack("<QQ", LRU_MAX_SESSION_COUNT, 0)
+
+
+def witness_image() -> bytes:
+    """rsm.GetWitnessSnapshot (snapshotio.go:139): a well-formed V2
+    container whose payload is the empty session bank."""
+    return write_v2(empty_lru_session())
+
+
+def validate_v2(data: bytes) -> bool:
+    """The reference receiver's validation, reimplemented from
+    rwv.go v2validator (AddChunk over the whole image + Validate):
+    header length sane, every block's CRC matches, tail magic + total
+    correct.  Exists so tests prove emitted bytes pass the EXACT
+    algorithm chunk.go:214 runs on an inbound stream."""
+    if len(data) < HEADER_SIZE:
+        return False
+    (hlen,) = struct.unpack_from("<Q", data, 0)
+    if hlen > HEADER_SIZE - 8:
+        return False
+    body = data[HEADER_SIZE:]
+    if len(body) < TAIL_SIZE:
+        return False
+    tail, blocks = body[-TAIL_SIZE:], body[:-TAIL_SIZE]
+    if tail[8:] != MAGIC:
+        return False
+    (total,) = struct.unpack_from("<Q", tail, 0)
+    if total != len(blocks):
+        return False
+    i = 0
+    step = BLOCK_SIZE + CHECKSUM_SIZE
+    while len(blocks) - i > step:
+        if not _block_ok(blocks[i:i + step]):
+            return False
+        i += step
+    rest = blocks[i:]
+    return len(rest) == 0 or _block_ok(rest)
+
+
+def _block_ok(block: bytes) -> bool:
+    if len(block) <= CHECKSUM_SIZE:
+        return False
+    payload, crc = block[:-CHECKSUM_SIZE], block[-CHECKSUM_SIZE:]
+    return struct.pack("<I", zlib.crc32(payload)) == crc

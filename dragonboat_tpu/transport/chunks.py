@@ -272,15 +272,25 @@ def split_snapshot_message_go(m: pb.Message, deployment_id: int,
                               chunk_size: int = SNAPSHOT_CHUNK_SIZE):
     """Yield reference-layout GoChunks for an InstallSnapshot
     (snapshot.go:204 getChunks + :225 loadChunkData read-at-send).
-    Witness snapshots are refused: the repo's witnesses never stream
-    (config.validate bars witness snapshots), and synthesizing the
-    reference's witness image (rsm.GetWitnessSnapshot) is out of scope."""
+    Witness snapshots ship as the reference's single synthetic chunk
+    (snapshot.go:262 getWitnessChunk) carrying a well-formed EMPTY image
+    in the REFERENCE container format (rsm/gosnapshot.py) — the Go
+    receiver validates every chunk-0 payload against its SnapshotHeader
+    layout (chunk.go:214 NewSnapshotValidator) even though witness
+    snapshots are partial and never recovered from."""
     from dragonboat_tpu.raftpb import gowire
 
     ss = m.snapshot
     if ss.witness:
-        raise ValueError("witness snapshot streaming on the go wire "
-                         "is not supported")
+        data = witness_image_bytes()
+        yield gowire.GoChunk(
+            shard_id=m.shard_id, replica_id=m.to, from_=m.from_,
+            chunk_id=0, chunk_count=1, chunk_size=len(data), data=data,
+            index=ss.index, term=ss.term, membership=ss.membership,
+            filepath="witness.snapshot", file_size=len(data),
+            deployment_id=deployment_id, file_chunk_id=0,
+            file_chunk_count=1, on_disk_index=0, witness=True)
+        return
     files: list[tuple[str, int, pb.SnapshotFile | None]] = []
     main_size = os.path.getsize(ss.filepath) if ss.filepath else 0
     if main_size == 0:
@@ -316,7 +326,7 @@ def split_snapshot_message_go(m: pb.Message, deployment_id: int,
                     file_info=sf if sf is not None else pb.SnapshotFile(
                         file_id=0, filepath=""),
                     on_disk_index=ss.on_disk_index,
-                    witness=ss.witness,
+                    witness=False,  # witness took the single-chunk branch above
                 )
                 chunk_id += 1
 
@@ -353,8 +363,6 @@ class GoChunkSink:
     def add(self, c) -> bool:
         if c.deployment_id != self.deployment_id:
             return False
-        if c.witness:
-            return False                 # symmetric with the send refusal
         key = (c.shard_id, c.replica_id, c.from_)
         if c.is_poison():
             # a failed sender poisons its stream (raftpb LastChunkCount-1,
@@ -543,3 +551,15 @@ def native_chunk_to_go(c: pb.Chunk, ss: "pb.Snapshot | None" = None):
         on_disk_index=ss.on_disk_index if ss is not None else 0,
         witness=ss.witness if ss is not None else False,
     )
+
+
+def witness_image_bytes() -> bytes:
+    """The witness chunk payload in the REFERENCE container format
+    (rsm.GetWitnessSnapshot): the Go receiver runs its snapshot
+    validator on every chunk-0 payload (chunk.go:214), so the image
+    must be bytes that validator accepts — witness snapshots being
+    partial (never recovered from) does not exempt them from the
+    byte-level check."""
+    from dragonboat_tpu.rsm.gosnapshot import witness_image
+
+    return witness_image()

@@ -185,13 +185,6 @@ class TransportHub:
         # reference's per-file Chunk records (no embedded message);
         # everything else ships the native concatenated stream
         go_wire = getattr(self.transport, "wire", "native") == "go"
-        if go_wire and m.snapshot.witness:
-            # documented go-wire descope: refuse CLEANLY here — letting
-            # the splitter raise inside the send job would b.fail() the
-            # address breaker on every raft retry until it opens and
-            # drops ALL traffic to that host, not just this stream
-            self._notify_snapshot_failed(m)
-            return False
 
         def job() -> None:
             if go_wire:

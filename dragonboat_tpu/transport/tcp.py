@@ -376,10 +376,9 @@ class TCPTransportFactory:
     (raftpb/gowire.py) — so a host can exchange raft traffic with
     reference hosts over DCN.  Snapshot streaming interops too: method
     200 requests carry reference-layout Chunks both ways (gowire
-    GoChunk + chunks.py split_snapshot_message_go/GoChunkSink), so a
-    lagging member on either side heals in-band.  The one residual
-    descope is witness-snapshot streaming (both sides refuse; the
-    repo's witnesses never take snapshots)."""
+    GoChunk + chunks.py split_snapshot_message_go/GoChunkSink) — file
+    catchup, chunkwriter live streams, and the single synthetic witness
+    chunk — so a lagging member on either side heals in-band."""
 
     def __init__(self, wire: str = "native") -> None:
         self.wire = wire
