@@ -148,3 +148,176 @@ def _block_ok(block: bytes) -> bool:
         return False
     payload, crc = block[:-CHECKSUM_SIZE], block[-CHECKSUM_SIZE:]
     return struct.pack("<I", zlib.crc32(payload)) == crc
+
+
+def read_v2(data: bytes) -> bytes:
+    """Extract the payload stream from a reference V2 container
+    (SnapshotReader semantics: skip the 1024-byte header region,
+    de-block verifying each CRC, strip the tail).  Raises ValueError on
+    any mismatch."""
+    if not validate_v2(data):
+        raise ValueError("not a valid reference V2 snapshot container")
+    blocks = data[HEADER_SIZE:-TAIL_SIZE]
+    out = bytearray()
+    step = BLOCK_SIZE + CHECKSUM_SIZE
+    i = 0
+    while i < len(blocks):
+        block = blocks[i:i + step]
+        out += block[:-CHECKSUM_SIZE]
+        i += step
+    return bytes(out)
+
+
+def looks_like_v2(data: bytes) -> bool:
+    """Cheap sniff: header-length field sane + the tail magic present.
+    (Our own container starts with the DBTPUSNP magic, whose first 8
+    bytes read as an impossibly large header length.)"""
+    if len(data) < HEADER_SIZE + TAIL_SIZE:
+        return False
+    (hlen,) = struct.unpack_from("<Q", data, 0)
+    return hlen <= HEADER_SIZE - 8 and data[-8:] == MAGIC
+
+
+# ---------------------------------------------------------------------------
+# session-bank translation (lrusession.go save/load <-> rsm/session.py)
+# ---------------------------------------------------------------------------
+
+
+def go_session_bank_decode(payload: bytes) -> tuple[list, int]:
+    """Parse the Go LRU session bank at the head of a payload stream:
+    ``u64 max | u64 count | count x (u64 json_len | Session JSON)``
+    (lrusession.go save + session.go save).  Returns ([(client_id,
+    responded_to, {series: (value, data_bytes)})...], bytes_consumed)."""
+    import base64
+    import json
+
+    if len(payload) < 16:
+        raise ValueError("go session bank: truncated")
+    count = struct.unpack_from("<Q", payload, 8)[0]
+    off = 16
+    sessions = []
+    for _ in range(count):
+        if off + 8 > len(payload):
+            raise ValueError("go session bank: truncated session")
+        (jlen,) = struct.unpack_from("<Q", payload, off)
+        off += 8
+        rec = json.loads(payload[off:off + jlen].decode())
+        off += jlen
+        hist = {}
+        for series, res in (rec.get("History") or {}).items():
+            d = res.get("Data")
+            hist[int(series)] = (
+                int(res.get("Value") or 0),
+                base64.b64decode(d) if d else b"",
+            )
+        sessions.append((int(rec.get("ClientID") or 0),
+                         int(rec.get("RespondedUpTo") or 0), hist))
+    return sessions, off
+
+
+def go_session_bank_encode(sessions: list) -> bytes:
+    """The inverse: our session records -> the Go bank bytes (JSON keys
+    as Go's json.Marshal of rsm.Session emits them; Go's Unmarshal is
+    order-insensitive)."""
+    import base64
+    import json
+
+    out = bytearray(struct.pack("<QQ", LRU_MAX_SESSION_COUNT,
+                                len(sessions)))
+    for client_id, responded_to, hist in sessions:
+        rec = {
+            "History": {
+                str(series): {
+                    "Value": value,
+                    "Data": (base64.b64encode(data).decode()
+                             if data else None),
+                }
+                for series, (value, data) in sorted(hist.items())
+            },
+            "ClientID": client_id,
+            "RespondedUpTo": responded_to,
+        }
+        blob = json.dumps(rec, separators=(",", ":")).encode()
+        out += struct.pack("<Q", len(blob))
+        out += blob
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# whole-image transcode (regular SM snapshots, file-based catchup)
+# ---------------------------------------------------------------------------
+
+
+def native_image_to_go(data: bytes) -> bytes:
+    """Our DBTPUSNP container -> the reference container: sessions
+    re-banked into the Go format, the user payload carried verbatim.
+    The result is what a Go peer's validator AND its recovery path
+    expect for a regular-SM snapshot image."""
+    import io
+
+    from dragonboat_tpu.rsm.session import LRUSession
+    from dragonboat_tpu.rsm.snapshotio import read_snapshot
+
+    session_bytes, reader = read_snapshot(io.BytesIO(data))
+    if getattr(reader, "shrunk", False):
+        # a shrunken image's empty payload is a bookkeeping artifact,
+        # not state; rebuilding it as a full reference container would
+        # bypass the receiver's shrunk guards and wipe the SM
+        raise ValueError("shrunken snapshot cannot cross the go wire")
+    user = b"".join(iter(lambda: reader.read(1 << 20), b""))
+    lru = LRUSession.load(io.BytesIO(session_bytes)) if session_bytes \
+        else LRUSession()
+    sessions = [
+        (s.client_id, s.responded_to,
+         {k: (r.value, r.data) for k, r in s.history.items()})
+        for s in lru.sessions.values()
+    ]
+    return write_v2(go_session_bank_encode(sessions) + user)
+
+
+def go_image_to_native(data: bytes) -> bytes:
+    """The reference container -> our DBTPUSNP container: the Go
+    session bank becomes our LRUSession image, the user payload is
+    carried verbatim — so a Go-written snapshot recovers a TPU replica
+    through the ordinary read_snapshot path (sessions included: dedup
+    state survives the fleet boundary)."""
+    import io
+
+    from dragonboat_tpu.rsm.session import LRUSession, Session
+    from dragonboat_tpu.statemachine import Result
+    from dragonboat_tpu.rsm.snapshotio import write_snapshot
+
+    payload = read_v2(data)
+    sessions, consumed = go_session_bank_decode(payload)
+    user = payload[consumed:]
+    lru = LRUSession()
+    for client_id, responded_to, hist in sessions:
+        s = Session(client_id=client_id, responded_to=responded_to)
+        for series, (value, d) in hist.items():
+            s.history[series] = Result(value=value, data=d)
+        lru.sessions[client_id] = s
+    sbuf = io.BytesIO()
+    lru.save(sbuf)
+    out = io.BytesIO()
+    write_snapshot(out, sbuf.getvalue(), lambda w: w.write(user))
+    return out.getvalue()
+
+
+def sniff_v2_file(path: str) -> bool:
+    """``looks_like_v2`` without reading the image: first 8 bytes
+    (header length — our DBTPUSNP magic reads as an impossible value)
+    + last 8 (tail magic)."""
+    import os
+
+    try:
+        size = os.path.getsize(path)
+        if size < HEADER_SIZE + TAIL_SIZE:
+            return False
+        with open(path, "rb") as f:
+            head = f.read(8)
+            f.seek(-8, 2)
+            tail = f.read(8)
+    except OSError:
+        return False
+    (hlen,) = struct.unpack("<Q", head)
+    return hlen <= HEADER_SIZE - 8 and tail == MAGIC

@@ -291,19 +291,42 @@ def split_snapshot_message_go(m: pb.Message, deployment_id: int,
             deployment_id=deployment_id, file_chunk_id=0,
             file_chunk_count=1, on_disk_index=0, witness=True)
         return
-    files: list[tuple[str, int, pb.SnapshotFile | None]] = []
-    main_size = os.path.getsize(ss.filepath) if ss.filepath else 0
-    if main_size == 0:
+    if not ss.filepath or os.path.getsize(ss.filepath) == 0:
         raise ValueError("empty snapshot file")  # snapshot.go:208 panic
-    files.append((ss.filepath, main_size, None))
+    # the Go receiver byte-validates AND later recovers from the main
+    # image in ITS container format — transcode ours (sessions
+    # re-banked, user payload verbatim; rsm/gosnapshot.py).  External
+    # files ride raw: has_file_info chunks are never validated and the
+    # bytes are the user's own.
+    import io
+
+    from dragonboat_tpu.rsm.gosnapshot import (
+        native_image_to_go,
+        sniff_v2_file,
+    )
+
+    if sniff_v2_file(ss.filepath):
+        # already the reference container: stream straight from disk
+        main_blob = None
+        main_size = os.path.getsize(ss.filepath)
+    else:
+        # transcode needs the whole image (sessions re-banked); sized
+        # by the SM snapshot, same order as the reference's own
+        # loadChunkData working set
+        with open(ss.filepath, "rb") as f:
+            main_blob = native_image_to_go(f.read())
+        main_size = len(main_blob)
+    files: list[tuple[bytes | None, str, int, pb.SnapshotFile | None]] = [
+        (main_blob, ss.filepath, main_size, None)]
     for sf in ss.files:
-        files.append((sf.filepath, sf.file_size, sf))
+        files.append((None, sf.filepath, sf.file_size, sf))
     per_file = [max(1, (sz + chunk_size - 1) // chunk_size)
-                for _, sz, _ in files]
+                for _, _, sz, _ in files]
     total = sum(per_file)
     chunk_id = 0
-    for (path, size, sf), count in zip(files, per_file):
-        with open(path, "rb") as f:
+    for (blob, path, size, sf), count in zip(files, per_file):
+        with (io.BytesIO(blob) if blob is not None
+              else open(path, "rb")) as f:
             for fcid in range(count):
                 data = f.read(chunk_size)
                 yield gowire.GoChunk(
@@ -436,8 +459,47 @@ class GoChunkSink:
                 del self.transfers[key]
                 completed = t
         if completed is not None:
+            try:
+                self._naturalize(completed)
+            except Exception:
+                # a malformed image must reject the TRANSFER (files
+                # cleaned), not kill the connection reader — every
+                # other malformed-chunk path returns False the same way
+                for pth in [completed.path] + [d for _, d in
+                                               completed.files]:
+                    try:
+                        os.remove(pth)
+                    except OSError:
+                        pass
+                return False
             self.deliver(self._to_message(completed), "")
         return True
+
+    @staticmethod
+    def _naturalize(t: _GoTransfer) -> None:
+        """A main image from a Go peer (or a transcoding TPU peer)
+        arrives in the reference container; rewrite it into the repo's
+        own format (sessions included) so the ordinary recovery path
+        reads it.  A TPU live stream (our container bytes, our magic)
+        passes through untouched; witness transfers are never
+        recovered from, so their image is left as received."""
+        if t.first is not None and t.first.witness:
+            return
+        from dragonboat_tpu.rsm.gosnapshot import (
+            go_image_to_native,
+            sniff_v2_file,
+        )
+
+        if not sniff_v2_file(t.path):
+            return                   # our own live stream: pass through
+        with open(t.path, "rb") as f:
+            data = f.read()
+        native = go_image_to_native(data)
+        tmp = t.path + ".transcode"
+        with open(tmp, "wb") as f:
+            f.write(native)
+        os.replace(tmp, t.path)
+        t.main_written = len(native)
 
     @staticmethod
     def _to_message(t: _GoTransfer) -> pb.Message:

@@ -376,9 +376,12 @@ class TCPTransportFactory:
     (raftpb/gowire.py) — so a host can exchange raft traffic with
     reference hosts over DCN.  Snapshot streaming interops too: method
     200 requests carry reference-layout Chunks both ways (gowire
-    GoChunk + chunks.py split_snapshot_message_go/GoChunkSink) — file
-    catchup, chunkwriter live streams, and the single synthetic witness
-    chunk — so a lagging member on either side heals in-band."""
+    GoChunk + chunks.py split_snapshot_message_go/GoChunkSink), with
+    SM images transcoded at the fleet boundary (rsm/gosnapshot.py:
+    reference container + re-banked sessions outbound, naturalized
+    inbound) — file catchup and witness heals work in both directions;
+    the one residual is a TPU on-disk SM's LIVE stream toward a real
+    Go receiver (streaming transcode is future work)."""
 
     def __init__(self, wire: str = "native") -> None:
         self.wire = wire

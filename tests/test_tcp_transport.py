@@ -439,8 +439,15 @@ def test_go_chunk_split_and_reassemble(tmp_path):
         split_snapshot_message_go,
     )
 
+    import io
+
+    from dragonboat_tpu.rsm.snapshotio import read_snapshot, write_snapshot
+
     main = tmp_path / "snap.gbsnap"
-    main.write_bytes(b"M" * (3 * 1024) + b"main-tail")
+    user_payload = b"M" * (3 * 1024) + b"main-tail"
+    buf = io.BytesIO()
+    write_snapshot(buf, b"", lambda w: w.write(user_payload))
+    main.write_bytes(buf.getvalue())
     xf1 = tmp_path / "ext1.bin"
     xf1.write_bytes(b"X" * 2048)
     xf2 = tmp_path / "ext2.bin"
@@ -458,7 +465,8 @@ def test_go_chunk_split_and_reassemble(tmp_path):
                    shard_id=9, term=7, snapshot=ss)
     chunks = list(split_snapshot_message_go(m, deployment_id=5,
                                             chunk_size=1024))
-    # per-file split: 4 main (3K+tail) + 2 + 1 chunks, global ids 0..6
+    # per-file split: N main (reference-container transcoded) + 2 + 1
+    # external chunks, global ids contiguous
     assert [c.chunk_id for c in chunks] == list(range(len(chunks)))
     assert all(c.chunk_count == len(chunks) for c in chunks)
     assert chunks[0].has_file_info is False
@@ -478,7 +486,11 @@ def test_go_chunk_split_and_reassemble(tmp_path):
     gss = got.snapshot
     assert gss.index == 42 and gss.term == 7 and gss.on_disk_index == 42
     assert gss.membership.addresses == {1: "a:1", 2: "b:2"}
-    assert open(gss.filepath, "rb").read() == main.read_bytes()
+    # the delivered main image is naturalized back to our container:
+    # byte layout differs (sessions re-banked through the go format),
+    # the recovered content must not
+    session_bytes, reader = read_snapshot(open(gss.filepath, "rb"))
+    assert b"".join(iter(lambda: reader.read(1 << 20), b"")) == user_payload
     assert len(gss.files) == 2
     assert open(gss.files[0].filepath, "rb").read() == xf1.read_bytes()
     assert open(gss.files[1].filepath, "rb").read() == xf2.read_bytes()
@@ -492,15 +504,22 @@ def test_go_chunk_sink_rejects(tmp_path):
         split_snapshot_message_go,
     )
 
+    import io
+
+    from dragonboat_tpu.rsm.snapshotio import write_snapshot
+
     main = tmp_path / "s.gbsnap"
-    main.write_bytes(b"z" * 4096)
+    buf = io.BytesIO()
+    write_snapshot(buf, b"", lambda w: w.write(b"z" * 4096))
+    main.write_bytes(buf.getvalue())
     m = pb.Message(type=pb.MessageType.INSTALL_SNAPSHOT, to=2, from_=1,
                    shard_id=3, term=2,
-                   snapshot=pb.Snapshot(filepath=str(main), file_size=4096,
+                   snapshot=pb.Snapshot(filepath=str(main),
+                                        file_size=main.stat().st_size,
                                         index=10, term=2, shard_id=3))
     chunks = list(split_snapshot_message_go(m, deployment_id=1,
                                             chunk_size=1024))
-    assert len(chunks) == 4
+    assert len(chunks) >= 4
     sink = GoChunkSink(str(tmp_path / "in"), deployment_id=1,
                        deliver=lambda *a: None)
     import dataclasses as dc
@@ -653,3 +672,45 @@ def test_witness_image_passes_reference_validator():
     bad[gs.HEADER_SIZE + 3] ^= 0xFF
     assert not gs.validate_v2(bytes(bad))
     assert not gs.validate_v2(img[:-1])
+
+
+def test_go_image_transcode_roundtrip():
+    """Our container -> reference container -> ours: sessions (dedup
+    state included) and the user payload survive the fleet boundary,
+    and the intermediate bytes pass the reference validator."""
+    import io
+
+    from dragonboat_tpu.rsm import gosnapshot as gs
+    from dragonboat_tpu.rsm.session import LRUSession, Session
+    from dragonboat_tpu.rsm.snapshotio import read_snapshot, write_snapshot
+    from dragonboat_tpu.statemachine import Result
+
+    lru = LRUSession()
+    s1 = Session(client_id=7, responded_to=3)
+    s1.history[4] = Result(value=40, data=b"resp-4")
+    s1.history[5] = Result(value=50, data=b"")
+    lru.sessions[7] = s1
+    lru.sessions[9] = Session(client_id=9, responded_to=0)
+    sbuf = io.BytesIO()
+    lru.save(sbuf)
+    payload = b"user-sm-bytes " * 300
+    out = io.BytesIO()
+    write_snapshot(out, sbuf.getvalue(), lambda w: w.write(payload))
+    native = out.getvalue()
+
+    go_img = gs.native_image_to_go(native)
+    assert gs.validate_v2(go_img)          # a Go receiver accepts it
+    # the Go payload stream = go session bank + verbatim user bytes
+    stream = gs.read_v2(go_img)
+    sessions, consumed = gs.go_session_bank_decode(stream)
+    assert stream[consumed:] == payload
+    assert {c for c, _, _ in sessions} == {7, 9}
+
+    back = gs.go_image_to_native(go_img)
+    session_bytes, reader = read_snapshot(io.BytesIO(back))
+    got = LRUSession.load(io.BytesIO(session_bytes))
+    assert got.sessions[7].responded_to == 3
+    assert got.sessions[7].history[4].value == 40
+    assert got.sessions[7].history[4].data == b"resp-4"
+    assert got.sessions[9].client_id == 9
+    assert b"".join(iter(lambda: reader.read(1 << 20), b"")) == payload
