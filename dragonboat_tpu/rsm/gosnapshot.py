@@ -168,16 +168,6 @@ def read_v2(data: bytes) -> bytes:
     return bytes(out)
 
 
-def looks_like_v2(data: bytes) -> bool:
-    """Cheap sniff: header-length field sane + the tail magic present.
-    (Our own container starts with the DBTPUSNP magic, whose first 8
-    bytes read as an impossibly large header length.)"""
-    if len(data) < HEADER_SIZE + TAIL_SIZE:
-        return False
-    (hlen,) = struct.unpack_from("<Q", data, 0)
-    return hlen <= HEADER_SIZE - 8 and data[-8:] == MAGIC
-
-
 # ---------------------------------------------------------------------------
 # session-bank translation (lrusession.go save/load <-> rsm/session.py)
 # ---------------------------------------------------------------------------
@@ -304,9 +294,9 @@ def go_image_to_native(data: bytes) -> bytes:
 
 
 def sniff_v2_file(path: str) -> bool:
-    """``looks_like_v2`` without reading the image: first 8 bytes
-    (header length — our DBTPUSNP magic reads as an impossible value)
-    + last 8 (tail magic)."""
+    """Cheap reference-container sniff without reading the image:
+    first 8 bytes (header length — our DBTPUSNP magic reads as an
+    impossible value) + last 8 (tail magic)."""
     import os
 
     try:
@@ -321,3 +311,155 @@ def sniff_v2_file(path: str) -> bool:
         return False
     (hlen,) = struct.unpack("<Q", head)
     return hlen <= HEADER_SIZE - 8 and tail == MAGIC
+
+
+class GoStreamTranscoder:
+    """Streaming our-container -> reference-container transcode (the
+    live-stream path: rsm/chunkwriter.py produces the repo container
+    progressively; a real Go receiver validates reference blocks as
+    they arrive, so the byte stream must be reference-shaped IN FLIGHT).
+
+    Feed container bytes with ``write``; reference-file fragments come
+    out through ``out(bytes)`` in validator-aligned units (the 1024-byte
+    header first, then 2 MiB CRC'd blocks, then the 16-byte tail at
+    ``close``).  Sessions are re-banked go-side; the user payload passes
+    through verbatim.  Mirrors what chunkwriter.go emits for a streamed
+    Go snapshot, dummy payload checksum included."""
+
+    def __init__(self, out) -> None:
+        self.out = out
+        self.buf = bytearray()
+        self.state = "preamble"          # -> session -> blocks -> done
+        self.version = 0
+        self.slen = 0
+        self.scrc = 0
+        self.payload_crc = 0
+        # go-side block framer state
+        self._go_block = bytearray()
+        self._started = False
+
+    # -- go-side emission ------------------------------------------------
+
+    def _emit_header(self) -> None:
+        # chunkwriter.go getHeader: streamed headers carry a DUMMY
+        # payload checksum ({0,0,0,0}) since the total is unknown
+        pre = _marshal_header(1, b"\x00\x00\x00\x00", None)
+        hc = struct.pack("<I", zlib.crc32(pre))
+        hdr = _marshal_header(1, b"\x00\x00\x00\x00", hc)
+        region = struct.pack("<Q", len(hdr)) + hdr
+        region += bytes(HEADER_SIZE - len(region))
+        self.out(region)
+        self._started = True
+
+    def _go_write(self, data: bytes) -> None:
+        self._go_block += data
+        while len(self._go_block) >= BLOCK_SIZE:
+            block = bytes(self._go_block[:BLOCK_SIZE])
+            del self._go_block[:BLOCK_SIZE]
+            self.out(block + struct.pack("<I", zlib.crc32(block)))
+
+    def _go_close(self) -> None:
+        if self._go_block:
+            block = bytes(self._go_block)
+            self._go_block.clear()
+            self.out(block + struct.pack("<I", zlib.crc32(block)))
+        self.out(struct.pack("<Q", self._emitted_block_bytes) + MAGIC)
+
+    # -- our-side incremental parse --------------------------------------
+
+    def write(self, data: bytes) -> None:
+        self.buf += data
+        progressed = True
+        while progressed:
+            progressed = False
+            if self.state == "preamble":
+                # MAGIC(8) version(4) hcrc(4) header(8) scrc(4)
+                if len(self.buf) < 28:
+                    return
+                if bytes(self.buf[:8]) != b"DBTPUSNP":
+                    raise ValueError("not a repo snapshot container")
+                (self.version,) = struct.unpack_from("<I", self.buf, 8)
+                if self.version & 0x100:
+                    # a shrunken image's empty payload is bookkeeping,
+                    # not state — transcoding it would bypass the
+                    # receiver's shrunk guards and wipe the SM
+                    raise ValueError(
+                        "shrunken snapshot cannot cross the go wire")
+                if self.version not in (2, 3):
+                    raise ValueError(
+                        f"unsupported container version {self.version}")
+                (self.slen,) = struct.unpack_from("<Q", self.buf, 16)
+                (self.scrc,) = struct.unpack_from("<I", self.buf, 24)
+                del self.buf[:28]
+                self.state = "session"
+                progressed = True
+            elif self.state == "session":
+                if len(self.buf) < self.slen:
+                    return
+                session = bytes(self.buf[:self.slen])
+                del self.buf[:self.slen]
+                if zlib.crc32(session) != self.scrc:
+                    raise ValueError("session checksum mismatch")
+                import io
+
+                from dragonboat_tpu.rsm.session import LRUSession
+
+                lru = (LRUSession.load(io.BytesIO(session))
+                       if session else LRUSession())
+                sessions = [
+                    (s.client_id, s.responded_to,
+                     {k: (r.value, r.data) for k, r in s.history.items()})
+                    for s in lru.sessions.values()
+                ]
+                self._emitted_block_bytes = 0
+                out0 = self.out
+
+                def counting(b, _o=out0, _s=self):
+                    if _s._started:
+                        _s._emitted_block_bytes += len(b)
+                    _o(b)
+
+                self.out = counting
+                self._emit_header()
+                self._go_write(go_session_bank_encode(sessions))
+                self.state = "blocks"
+                progressed = True
+            elif self.state == "blocks":
+                if len(self.buf) < 4:
+                    return
+                (ln,) = struct.unpack_from("<I", self.buf, 0)
+                if ln == 0:                 # terminator + payload crc
+                    if len(self.buf) < 8:
+                        return
+                    (expect,) = struct.unpack_from("<I", self.buf, 4)
+                    if expect != self.payload_crc:
+                        raise ValueError("payload checksum mismatch")
+                    del self.buf[:8]
+                    self.state = "done"
+                    return
+                hdr = 9 if self.version >= 3 else 8
+                if len(self.buf) < hdr + ln:
+                    return
+                if self.version >= 3:
+                    crc, flag = struct.unpack_from("<IB", self.buf, 4)
+                else:
+                    (crc,) = struct.unpack_from("<I", self.buf, 4)
+                    flag = 0
+                stored = bytes(self.buf[hdr:hdr + ln])
+                del self.buf[:hdr + ln]
+                expect = (zlib.crc32(stored, zlib.crc32(bytes([flag])))
+                          if self.version >= 3 else zlib.crc32(stored))
+                if expect != crc:
+                    raise ValueError("block checksum mismatch")
+                block = zlib.decompress(stored) if flag else stored
+                self.payload_crc = zlib.crc32(block, self.payload_crc)
+                self._go_write(block)
+                progressed = True
+            else:
+                return
+
+    def close(self) -> None:
+        if self.state != "done":
+            raise ValueError(
+                f"stream ended mid-{self.state} (truncated container)")
+        self._go_close()

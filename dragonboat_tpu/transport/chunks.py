@@ -298,60 +298,66 @@ def split_snapshot_message_go(m: pb.Message, deployment_id: int,
     # re-banked, user payload verbatim; rsm/gosnapshot.py).  External
     # files ride raw: has_file_info chunks are never validated and the
     # bytes are the user's own.
-    import io
-
     from dragonboat_tpu.rsm.gosnapshot import (
         native_image_to_go,
         sniff_v2_file,
     )
 
-    if sniff_v2_file(ss.filepath):
-        # already the reference container: stream straight from disk
-        main_blob = None
-        main_size = os.path.getsize(ss.filepath)
-    else:
-        # transcode needs the whole image (sessions re-banked); sized
-        # by the SM snapshot, same order as the reference's own
-        # loadChunkData working set
-        with open(ss.filepath, "rb") as f:
-            main_blob = native_image_to_go(f.read())
-        main_size = len(main_blob)
-    files: list[tuple[bytes | None, str, int, pb.SnapshotFile | None]] = [
-        (main_blob, ss.filepath, main_size, None)]
-    for sf in ss.files:
-        files.append((None, sf.filepath, sf.file_size, sf))
-    per_file = [max(1, (sz + chunk_size - 1) // chunk_size)
-                for _, _, sz, _ in files]
-    total = sum(per_file)
-    chunk_id = 0
-    for (blob, path, size, sf), count in zip(files, per_file):
-        with (io.BytesIO(blob) if blob is not None
-              else open(path, "rb")) as f:
-            for fcid in range(count):
-                data = f.read(chunk_size)
-                yield gowire.GoChunk(
-                    shard_id=m.shard_id,
-                    replica_id=m.to,
-                    from_=m.from_,
-                    chunk_id=chunk_id,
-                    chunk_count=total,
-                    chunk_size=len(data),
-                    data=data,
-                    index=ss.index,
-                    term=ss.term,
-                    membership=ss.membership,
-                    filepath=path,
-                    file_size=size,
-                    deployment_id=deployment_id,
-                    file_chunk_id=fcid,
-                    file_chunk_count=count,
-                    has_file_info=sf is not None,
-                    file_info=sf if sf is not None else pb.SnapshotFile(
-                        file_id=0, filepath=""),
-                    on_disk_index=ss.on_disk_index,
-                    witness=False,  # witness took the single-chunk branch above
-                )
-                chunk_id += 1
+    main_path = ss.filepath
+    tmp_path = None
+    if not sniff_v2_file(main_path):
+        # transcode into a sibling temp file and stream from disk: the
+        # paced transfer can run for minutes and must not pin a
+        # multi-GB image (or its transcoded copy) in memory
+        tmp_path = main_path + ".gowire"
+        with open(main_path, "rb") as f:
+            img = native_image_to_go(f.read())
+        with open(tmp_path, "wb") as f:
+            f.write(img)
+        del img
+        main_path = tmp_path
+    try:
+        files: list[tuple[str, int, pb.SnapshotFile | None]] = [
+            (main_path, os.path.getsize(main_path), None)]
+        for sf in ss.files:
+            files.append((sf.filepath, sf.file_size, sf))
+        per_file = [max(1, (sz + chunk_size - 1) // chunk_size)
+                    for _, sz, _ in files]
+        total = sum(per_file)
+        chunk_id = 0
+        for (path, size, sf), count in zip(files, per_file):
+            with open(path, "rb") as f:
+                for fcid in range(count):
+                    data = f.read(chunk_size)
+                    yield gowire.GoChunk(
+                        shard_id=m.shard_id,
+                        replica_id=m.to,
+                        from_=m.from_,
+                        chunk_id=chunk_id,
+                        chunk_count=total,
+                        chunk_size=len(data),
+                        data=data,
+                        index=ss.index,
+                        term=ss.term,
+                        membership=ss.membership,
+                        filepath=path,
+                        file_size=size,
+                        deployment_id=deployment_id,
+                        file_chunk_id=fcid,
+                        file_chunk_count=count,
+                        has_file_info=sf is not None,
+                        file_info=sf if sf is not None
+                        else pb.SnapshotFile(file_id=0, filepath=""),
+                        on_disk_index=ss.on_disk_index,
+                        witness=False,  # witness branch returned above
+                    )
+                    chunk_id += 1
+    finally:
+        if tmp_path is not None:
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
 
 
 @dataclass
@@ -465,8 +471,8 @@ class GoChunkSink:
                 # a malformed image must reject the TRANSFER (files
                 # cleaned), not kill the connection reader — every
                 # other malformed-chunk path returns False the same way
-                for pth in [completed.path] + [d for _, d in
-                                               completed.files]:
+                for pth in ([completed.path, completed.path + ".transcode"]
+                            + [d for _, d in completed.files]):
                     try:
                         os.remove(pth)
                     except OSError:
@@ -566,53 +572,61 @@ class GoChunkSink:
 
 
 def adapt_native_chunks_to_go(chunks):
-    """Adapt a NATIVE chunk stream to reference-layout GoChunks,
-    remembering the chunk-0 snapshot so membership / on_disk_index /
-    witness are stamped on EVERY chunk the way the reference's
-    ChunkWriter does (chunkwriter.go getChunk) — receivers read chunk 0,
-    but the per-chunk fields keep the byte stream reference-shaped.
+    """Adapt a NATIVE streamed chunk sequence (the on-disk SM live
+    stream, rsm/chunkwriter.py — repo-container bytes cut into chunks)
+    into reference-layout GoChunks carrying the REFERENCE container,
+    transcoded in flight (rsm/gosnapshot.GoStreamTranscoder: sessions
+    re-banked, user payload verbatim, reference blocks + tail) — a
+    real Go receiver validates the blocks as they arrive, so the bytes
+    must be reference-shaped on the wire, not just at rest.  Chunk
+    numbering follows chunkwriter.go: mid chunks carry chunk_count=0,
+    and a final EMPTY LastChunkCount chunk closes the stream.
     Already-adapted GoChunks pass through."""
+    from dragonboat_tpu.raftpb import gowire
+    from dragonboat_tpu.rsm.gosnapshot import GoStreamTranscoder
+
     meta = None
+    first = None
+    tr = None
+    pending: list[bytes] = []
+    chunk_id = 0
+
+    def go_chunk(data: bytes, count: int):
+        nonlocal chunk_id
+        c0 = first
+        ss = meta
+        ck = gowire.GoChunk(
+            shard_id=c0.shard_id, replica_id=c0.replica_id,
+            from_=c0.from_, chunk_id=chunk_id, chunk_size=len(data),
+            chunk_count=count, data=data, index=c0.index, term=c0.term,
+            membership=ss.membership if ss is not None else pb.Membership(),
+            filepath=f"snapshot-{c0.index:016X}.gbsnap",
+            deployment_id=c0.deployment_id,
+            file_chunk_id=chunk_id, file_chunk_count=count,
+            on_disk_index=ss.on_disk_index if ss is not None else 0,
+            witness=False,
+        )
+        chunk_id += 1
+        return ck
+
     for c in chunks:
         if not isinstance(c, pb.Chunk):
             yield c
             continue
         if c.message is not None:
             meta = c.message.snapshot
-        yield native_chunk_to_go(c, meta)
-
-
-def native_chunk_to_go(c: pb.Chunk, ss: "pb.Snapshot | None" = None):
-    """Adapt one NATIVE streamed chunk (rsm/chunkwriter.py — chunk 0
-    carries the InstallSnapshot message; the tail carries
-    chunk_count=id+1 + total file_size) to the reference layout.
-    ``ss`` is the stream's snapshot meta (threaded from chunk 0 by
-    adapt_native_chunks_to_go); the filepath is the reference's
-    snapshot filename convention (server.GetSnapshotFilename — the
-    receiver re-bases it locally anyway)."""
-    from dragonboat_tpu.raftpb import gowire
-
-    if ss is None and c.message is not None:
-        ss = c.message.snapshot
-    return gowire.GoChunk(
-        shard_id=c.shard_id,
-        replica_id=c.replica_id,
-        from_=c.from_,
-        chunk_id=c.chunk_id,
-        chunk_size=c.chunk_size,
-        chunk_count=c.chunk_count,
-        data=c.data,
-        index=c.index,
-        term=c.term,
-        membership=ss.membership if ss is not None else pb.Membership(),
-        filepath=f"snapshot-{c.index:016X}.gbsnap",
-        file_size=c.file_size,
-        deployment_id=c.deployment_id,
-        file_chunk_id=c.chunk_id,
-        file_chunk_count=c.chunk_count,
-        on_disk_index=ss.on_disk_index if ss is not None else 0,
-        witness=ss.witness if ss is not None else False,
-    )
+        if first is None:
+            first = c
+            tr = GoStreamTranscoder(pending.append)
+        tr.write(c.data)
+        if c.is_last():
+            tr.close()
+        while pending:
+            yield go_chunk(pending.pop(0), 0)
+    if first is not None:
+        # chunkwriter.go getTailChunk: an empty LastChunkCount chunk
+        # closes the streamed transfer
+        yield go_chunk(b"", gowire.LAST_CHUNK_COUNT)
 
 
 def witness_image_bytes() -> bytes:

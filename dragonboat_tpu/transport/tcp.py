@@ -377,11 +377,11 @@ class TCPTransportFactory:
     reference hosts over DCN.  Snapshot streaming interops too: method
     200 requests carry reference-layout Chunks both ways (gowire
     GoChunk + chunks.py split_snapshot_message_go/GoChunkSink), with
-    SM images transcoded at the fleet boundary (rsm/gosnapshot.py:
-    reference container + re-banked sessions outbound, naturalized
-    inbound) — file catchup and witness heals work in both directions;
-    the one residual is a TPU on-disk SM's LIVE stream toward a real
-    Go receiver (streaming transcode is future work)."""
+    SM images transcoded at the fleet boundary (rsm/gosnapshot.py):
+    reference container + re-banked sessions outbound — in flight for
+    live streams (GoStreamTranscoder), whole-image for file catchup —
+    and naturalized inbound before recovery.  File catchup, on-disk
+    live streams and witness heals all interop in both directions."""
 
     def __init__(self, wire: str = "native") -> None:
         self.wire = wire

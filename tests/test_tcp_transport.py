@@ -539,10 +539,12 @@ def test_go_chunk_sink_rejects(tmp_path):
 
 def test_tcp_ondisk_live_stream_go_wire(monkeypatch):
     """On-disk SM live stream over the reference byte format: the
-    native ChunkWriter chunks are adapted per chunk (hub
-    native_chunk_to_go) and reassembled by the go-wire sink's
-    streamed-tail rules — the second interop shape (chunkwriter.go
-    LastChunkCount-style streams) after the file-based catchup above."""
+    native ChunkWriter stream is transcoded IN FLIGHT into the
+    reference container (hub adapt_native_chunks_to_go ->
+    GoStreamTranscoder) and reassembled by the go-wire sink's
+    streamed-tail rules, then naturalized back — the second interop
+    shape (chunkwriter.go LastChunkCount streams) after the file-based
+    catchup above."""
     from dragonboat_tpu.rsm.statemachine import StateMachine
     from test_snapshot_stream import DiskKV
 
