@@ -7,7 +7,8 @@
 # mmap'd segments and the suite compiles hundreds of geometries; the
 # map count crossed 65,530 at exactly the crash position.
 # tests/conftest.py now fences it (jax.clear_caches() above 45k maps),
-# and one-process runs survive (361 passed, fence fired 37x, 2026-07-31).
+# and one-process runs survive: GREEN x3 on 2026-07-31 (361+1-flake /
+# 365 clean / 365 clean; the fence fired 37x on the first run).
 # Chunking is kept as belt+braces for CI determinism on slow boxes.
 # Exit status is non-zero if any chunk fails.
 set -e
