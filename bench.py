@@ -25,7 +25,9 @@ read_accounting: "served"; BENCH_SERVED=0 skips), C 10k-shard election
 storm with randomized drops + pre-vote (config #4), D
 membership-change wave + device log compaction under load (config #5:
 every group commits a CC mid-stream; BENCH_CC=0 skips,
-BENCH_CC_ROUNDS sets the wave count).  BENCH_TIME_BUDGET (default
+BENCH_CC_ROUNDS sets the wave count), E config #1 single-shard
+datapoint (one 3-replica shard at G=1, vs the reference's 1.25M w/s
+single-shard peak; BENCH_CONFIG1=0 skips).  BENCH_TIME_BUDGET (default
 2400 s) soft-bounds the run: a phase that would overrun is skipped
 with a note in the record, never silently truncated.
 
@@ -57,6 +59,9 @@ sys.path.insert(0, REPO)
 from dragonboat_tpu.hostenv import clean_cpu_env, probe_devices  # noqa: E402
 
 BASELINE_WPS = 9e6
+# BASELINE config #1: ONE 3-replica shard, 16B payloads — the
+# reference's single-shard peak (BASELINE.md)
+CONFIG1_BASELINE_WPS = 1.25e6
 # set once any provisional measurement line has been emitted: a later
 # total failure must not print a value=0 line OVER a valid headline
 _PROVISIONAL_EMITTED = False
@@ -283,6 +288,63 @@ def _run_served(replicas: int, groups: int, mixed_steps: int,
         "step_ms": round(dt / mixed_steps * 1e3, 3),
         "table": "direct-mapped",
         "vs_baseline_mixed": round(ops / 11e6, 4),
+    }
+
+
+def _run_single_shard(replicas: int, steps: int) -> dict:
+    """BASELINE config #1: one 3-replica shard, 16B payloads.  The [G]
+    batch parallelism that carries the headline cannot help at G=1 —
+    this datapoint isolates per-shard pipeline depth (proposal_cap
+    writes per device step) against the reference's 1.25M writes/s
+    single-shard peak.  Standalone cluster so the main-phase state is
+    untouched and a failure here cannot poison the rest of the record."""
+    import numpy as np
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core import params as KP
+
+    kp = bench_params(replicas)
+    state = make_cluster(kp, 1, replicas)
+    state, box = elect_all(kp, replicas, state)
+    lead = np.asarray(state.role) == KP.LEADER
+
+    def run(iters):
+        nonlocal state, box
+        state, box = run_steps(kp, replicas, iters, True, True, state, box)
+
+    def committed() -> int:
+        return int(np.asarray(state.committed)[lead].astype(np.int64).sum())
+
+    # G=1 launches are tiny; one fixed chunk keeps the jit-variant count
+    # (and so the warmup compile cost) at exactly two executables
+    chunk = 25
+    run(min(chunk, steps))
+    if steps % chunk:
+        run(steps % chunk)
+    state.committed.block_until_ready()
+    c0 = committed()
+    t0 = time.time()
+    done = 0
+    while done < steps:
+        n = min(chunk, steps - done)
+        run(n)
+        done += n
+    state.committed.block_until_ready()
+    dt = time.time() - t0
+    writes = committed() - c0
+    wps = writes / dt
+    return {
+        "groups": 1,
+        "steps": steps,
+        "step_ms": round(dt / steps * 1e3, 3),
+        "writes": writes,
+        "writes_per_s": round(wps),
+        "vs_baseline_config1": round(wps / CONFIG1_BASELINE_WPS, 4),
     }
 
 
@@ -647,6 +709,22 @@ def _measure(platform: str, groups: int, steps: int) -> None:
                 "compaction_floor_advance": snap1 - snap0,
             }
 
+        # ---- phase E: config #1 single-shard datapoint — the G=1
+        # write throughput every other phase deliberately avoids
+        # (batching across groups is the whole thesis; this measures
+        # what ONE shard gets) ----
+        if os.environ.get("BENCH_CONFIG1", "1") != "1":
+            detail["config1_single_shard"] = {"skipped": "BENCH_CONFIG1=0"}
+        elif not time_left(120):
+            detail["config1_single_shard"] = {
+                "skipped": "time budget exhausted before config-1 phase"}
+        else:
+            try:
+                detail["config1_single_shard"] = _run_single_shard(
+                    replicas, max(50, steps))
+            except Exception as e:  # must not cost the whole record
+                detail["config1_single_shard"] = {"error": repr(e)[-300:]}
+
         # ---- phase B2: 9:1 mix with reads SERVED — the recorded
         # config-#3 number.  A fresh device-SM cluster at the same G:
         # payloads ride the replicated lv ring into the range apply, and
@@ -700,8 +778,15 @@ def run_serve_bench() -> None:
     full stack) — the kernel-only phases above measure the device
     ceiling; this measures the product.
 
+    Two payload phases: 16B uncompressed (the headline shape), then
+    1024B with entry_compression="snappy" on a second shard set — the
+    r4 entry-compression codec measured on the path that actually
+    invokes it (node.propose encodes at propose time, node.py:301).
+
     Knobs: BENCH_SERVE_SHARDS (default 32), BENCH_SERVE_SECONDS (5),
-    BENCH_SERVE_WINDOW (pipelined proposals per shard, 32)."""
+    BENCH_SERVE_WINDOW (pipelined proposals per shard, 32),
+    BENCH_SERVE_1024_SHARDS (default min(8, shards); 0 skips the
+    compressed-payload phase)."""
     import shutil
     import tempfile
     import threading
@@ -734,9 +819,15 @@ def run_serve_bench() -> None:
     n_shards = int(os.environ.get("BENCH_SERVE_SHARDS", "32"))
     seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "5"))
     window = int(os.environ.get("BENCH_SERVE_WINDOW", "32"))
+    n_comp = int(os.environ.get("BENCH_SERVE_1024_SHARDS",
+                                str(min(8, n_shards))))
     shards = tuple(range(1, n_shards + 1))
+    # the compressed-payload shard set rides the same hosts under its
+    # own shard ids; both sets exist from startup (one election wait)
+    comp_shards = tuple(range(n_shards + 1, n_shards + 1 + n_comp))
     addrs = {1: "sv-1", 2: "sv-2", 3: "sv-3"}
-    ex = ExpertConfig(kernel_log_cap=128, kernel_capacity=n_shards,
+    ex = ExpertConfig(kernel_log_cap=128,
+                      kernel_capacity=n_shards + n_comp,
                       kernel_apply_batch=32, kernel_compaction_overhead=16)
     hosts = {}
     # REAL durability: each host gets a tan LogDB on disk so every write
@@ -753,84 +844,112 @@ def run_serve_bench() -> None:
                 nh.start_replica(addrs, False, NullSM, Config(
                     shard_id=sid, replica_id=rid, election_rtt=10,
                     heartbeat_rtt=2, device_resident=True))
+            for sid in comp_shards:
+                nh.start_replica(addrs, False, NullSM, Config(
+                    shard_id=sid, replica_id=rid, election_rtt=10,
+                    heartbeat_rtt=2, device_resident=True,
+                    entry_compression="snappy"))
+        all_shards = shards + comp_shards
         deadline = _t.time() + 120
         elected = 0
         while _t.time() < deadline:
-            elected = sum(1 for s in shards
+            elected = sum(1 for s in all_shards
                           if any(hosts[r].get_leader_id(s)[1]
                                  for r in addrs))
-            if elected == n_shards:
+            if elected == len(all_shards):
                 break
             _t.sleep(0.1)
 
-        done = threading.Event()
-        counts = [0] * n_shards
-        lats: list[list[float]] = [[] for _ in range(n_shards)]
+        def measure_window(sids: tuple, payload: bytes,
+                           run_s: float) -> dict:
+            done = threading.Event()
+            counts = [0] * len(sids)
+            lats: list[list[float]] = [[] for _ in sids]
 
-        def writer(i: int, sid: int) -> None:
-            # steady pipelined client: the window stays FULL — one new
-            # proposal is issued as each oldest completes (no batch
-            # barrier); the leader host is re-resolved on failures
-            from collections import deque
+            def writer(i: int, sid: int) -> None:
+                # steady pipelined client: the window stays FULL — one
+                # new proposal is issued as each oldest completes (no
+                # batch barrier); the leader host is re-resolved on
+                # failures
+                from collections import deque
 
-            payload = b"x" * 16
-            sess = Session.new_noop_session(sid)
+                sess = Session.new_noop_session(sid)
 
-            def leader_host():
-                lid, ok = hosts[1].get_leader_id(sid)
-                return hosts[lid if ok and lid in hosts else 1]
+                def leader_host():
+                    lid, ok = hosts[1].get_leader_id(sid)
+                    return hosts[lid if ok and lid in hosts else 1]
 
-            futs: deque = deque()
-            while not done.is_set():
-                try:
-                    nh = leader_host()
-                    while len(futs) < window:
-                        futs.append((nh.propose(sess, payload,
-                                                timeout_s=10.0),
-                                     _t.time()))
-                    f, t0 = futs.popleft()
-                    f.get(10.0)
-                    counts[i] += 1
-                    lats[i].append(_t.time() - t0)
-                except Exception:
-                    futs.clear()   # window poisoned by a leader move
-                    _t.sleep(0.02)
+                futs: deque = deque()
+                while not done.is_set():
+                    try:
+                        nh = leader_host()
+                        while len(futs) < window:
+                            futs.append((nh.propose(sess, payload,
+                                                    timeout_s=10.0),
+                                         _t.time()))
+                        f, t0 = futs.popleft()
+                        f.get(10.0)
+                        counts[i] += 1
+                        lats[i].append(_t.time() - t0)
+                    except Exception:
+                        futs.clear()   # window poisoned by a leader move
+                        _t.sleep(0.02)
 
-        threads = [threading.Thread(target=writer, args=(i, sid),
-                                    daemon=True)
-                   for i, sid in enumerate(shards)]
-        t_start = _t.time()
-        for t in threads:
-            t.start()
-        _t.sleep(seconds)
-        # snapshot the window BEFORE done/join: the drain tail (writers
-        # blocked in f.get timeouts) must not dilute the steady-state rate
-        wall = _t.time() - t_start
-        total = sum(counts)
-        done.set()
-        for t in threads:
-            t.join(timeout=15)
-        all_lats = sorted(x for li in lats for x in li)
+            threads = [threading.Thread(target=writer, args=(i, sid),
+                                        daemon=True)
+                       for i, sid in enumerate(sids)]
+            t_start = _t.time()
+            for t in threads:
+                t.start()
+            _t.sleep(run_s)
+            # snapshot the window BEFORE done/join: the drain tail
+            # (writers blocked in f.get timeouts) must not dilute the
+            # steady-state rate
+            wall = _t.time() - t_start
+            total = sum(counts)
+            done.set()
+            for t in threads:
+                t.join(timeout=15)
+            all_lats = sorted(x for li in lats for x in li)
 
-        def pct(q):
-            return (round(all_lats[int(q * (len(all_lats) - 1))] * 1e3, 2)
-                    if all_lats else None)
+            def pct(q):
+                return (round(all_lats[int(q * (len(all_lats) - 1))]
+                              * 1e3, 2) if all_lats else None)
 
+            return {
+                "shards": len(sids),
+                "seconds": round(wall, 2),
+                "writes": total,
+                "writes_per_s": round(total / wall),
+                "client_latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+            }
+
+        main_rec = measure_window(shards, b"x" * 16, seconds)
+        detail = {
+            "mode": "serve",
+            "shards": n_shards,
+            "window": window,
+            "seconds": main_rec["seconds"],
+            "writes": main_rec["writes"],
+            "elected": elected,
+            "client_latency_ms": main_rec["client_latency_ms"],
+        }
+        # ---- 1024B payload phase: large writes through the snappy
+        # entry-compression codec (node.propose encodes; the 16B phase
+        # never invokes it — 1024B is the shape compression exists for)
+        if n_comp > 0:
+            comp_rec = measure_window(comp_shards, b"x" * 1024, seconds)
+            comp_rec["payload_bytes"] = 1024
+            comp_rec["entry_compression"] = "snappy"
+            detail["payload_1024"] = comp_rec
+        wps = main_rec["writes"] / main_rec["seconds"]
         emit({
             "metric": (f"serving-path writes/sec, {n_shards} shards x 3 "
                        f"replicas, 16B, window {window}"),
-            "value": round(total / wall),
+            "value": round(wps),
             "unit": "writes/s",
-            "vs_baseline": round(total / wall / BASELINE_WPS, 4),
-            "detail": {
-                "mode": "serve",
-                "shards": n_shards,
-                "window": window,
-                "seconds": round(wall, 2),
-                "writes": total,
-                "elected": elected,
-                "client_latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
-            },
+            "vs_baseline": round(wps / BASELINE_WPS, 4),
+            "detail": detail,
         })
     finally:
         for nh in hosts.values():

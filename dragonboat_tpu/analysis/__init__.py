@@ -1,0 +1,18 @@
+"""Project-native static analysis (``scripts/lint.py``).
+
+Four passes guard the invariants the test suite cannot watch directly:
+
+- ``tracer_safety``  — no host control flow / host syncs inside jitted scope
+  (the branchless-kernel contract, core/kernel.py);
+- ``hlo_budget``     — the lowered step kernel stays within the checked-in
+  gather/scatter/while budget (``hlo_budget.json``; the r5 155->32
+  gather prune, PERF.md, as a permanent gate);
+- ``concurrency``    — ``# guarded-by: <lock>`` discipline on mutable
+  attributes of classes shared across threads;
+- ``determinism``    — no wall-clock, unseeded RNG, or set-iteration-order
+  dependence in the core/ and rsm/ replay paths.
+
+Pre-existing violations are either fixed or waived in ``waivers.toml``
+with a one-line reason.  Each pass exposes ``run(root, files=None)``
+returning ``list[common.Finding]`` so tests can point it at fixtures.
+"""

@@ -1,0 +1,148 @@
+"""HLO budget gate: the step kernel's op counts stay within budget.
+
+Lowers the self-driving bench loop (``bench_loop.run_steps``, the
+20-step ``fori_loop`` over the full cluster step) with the **onehot**
+ring-read config — the device-shaped graph — on the CPU backend, runs
+XLA's optimization pipeline, and counts ``gather`` / ``scatter`` /
+``while`` instructions in the optimized HLO.  Counts above the
+checked-in ``analysis/hlo_budget.json`` fail the lint.
+
+This turns the r5 gather prune (155 -> 32 gathers, PERF.md) into a
+permanent gate: a change that reintroduces per-lane gathers or a
+dynamic scatter — the exact op classes that serialize over [G] or
+miscompile on TPU v5e — fails CI instead of waiting for the next
+device bench window.
+
+Counts are group-count-independent (instruction count, not instruction
+size — verified 64 vs 1024 groups), so the gate measures at a small G
+for speed.  The budget-update workflow when a kernel change
+legitimately shifts the counts: run ``python scripts/lint.py
+--reseed-hlo-budget``, review the diff of ``hlo_budget.json``, and
+justify the new numbers in the PR alongside a PERF.md note.
+
+The lowering path emits ``tracing.annotate`` spans (``lint.hlo.build``
+/ ``lint.hlo.lower`` / ``lint.hlo.compile``) so a profiler capture of a
+lint run attributes its cost like any other engine phase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from dragonboat_tpu.analysis.common import Finding, rel
+
+PASS = "hlo-budget"
+
+BUDGET_FILE = "dragonboat_tpu/analysis/hlo_budget.json"
+
+# Gated opcodes.  ``gather``/``scatter`` are the TPU-hostile op classes
+# (PERF.md r2/r5); ``while`` bounds control-flow regions (the budget is
+# 1 fori_loop + 4 inbox-family scans — an accidental lax.scan in a
+# handler shows up here).
+GATED_OPS = ("gather", "scatter", "while")
+
+
+def _count_ops(hlo_text: str) -> dict[str, int]:
+    """Instruction counts by opcode in HLO text.
+
+    Opcode occurrences are counted as ``" <op>("`` which cannot collide
+    with fused spellings (``all-gather(``, ``select-and-scatter(``,
+    ``dynamic-update-slice(``) or with metadata paths (``while/body``).
+    """
+    ops = GATED_OPS + ("dynamic-slice", "dynamic-update-slice")
+    return {op.replace("-", "_"): hlo_text.count(f" {op}(") for op in ops}
+
+
+def measure(groups: int = 64, replicas: int = 3, iters: int = 20,
+            onehot_reads: bool = True) -> dict[str, int]:
+    """Optimized-HLO op counts for the bench step loop on CPU."""
+    from dragonboat_tpu import tracing
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core.kstate import empty_inbox
+
+    with tracing.annotate("lint.hlo.build"):
+        # onehot_reads is keyed off the *target* platform; lowering runs
+        # on CPU either way (JAX_PLATFORMS=cpu, set by the runner)
+        kp = bench_params(replicas,
+                          platform="tpu" if onehot_reads else "cpu")
+        state = make_cluster(kp, groups, replicas)
+        box = empty_inbox(kp, state.term.shape[0])
+    with tracing.annotate("lint.hlo.lower"):
+        lowered = run_steps.lower(kp, replicas, iters, True, True,
+                                  state, box)
+    with tracing.annotate("lint.hlo.compile"):
+        compiled = lowered.compile()
+    return _count_ops(compiled.as_text())
+
+
+def load_budget(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def run(root: str, budget_path: str | None = None,
+        measured: dict[str, int] | None = None) -> list[Finding]:
+    """Gate ``measured`` (or a fresh measurement) against the budget."""
+    path = budget_path or os.path.join(root, BUDGET_FILE)
+    relpath = rel(root, path)
+    if not os.path.exists(path):
+        return [Finding(PASS, relpath, 1, "HB000",
+                        "budget file missing — run scripts/lint.py "
+                        "--reseed-hlo-budget to seed it")]
+    spec = load_budget(path)
+    cfg = spec.get("config", {})
+    if measured is None:
+        measured = measure(
+            groups=cfg.get("groups", 64),
+            replicas=cfg.get("replicas", 3),
+            iters=cfg.get("iters", 20),
+            onehot_reads=cfg.get("onehot_reads", True))
+    findings = []
+    for op in GATED_OPS:
+        key = op.replace("-", "_")
+        limit = spec["budget"].get(key)
+        got = measured.get(key, 0)
+        if limit is not None and got > limit:
+            findings.append(Finding(
+                PASS, relpath, 1, "HB001",
+                f"optimized-HLO `{op}` count {got} exceeds budget {limit} "
+                f"(the kernel regressed toward per-lane {op}s; if the "
+                "change is justified, --reseed-hlo-budget and record why "
+                "in PERF.md)"))
+    return findings
+
+
+def reseed(root: str, budget_path: str | None = None,
+           groups: int = 64, replicas: int = 3, iters: int = 20,
+           onehot_reads: bool = True) -> dict:
+    """Measure and (re)write the budget file; returns the new spec."""
+    path = budget_path or os.path.join(root, BUDGET_FILE)
+    measured = measure(groups=groups, replicas=replicas, iters=iters,
+                       onehot_reads=onehot_reads)
+    spec = {
+        "config": {
+            "kernel": "bench_loop.run_steps",
+            "groups": groups,
+            "replicas": replicas,
+            "iters": iters,
+            "onehot_reads": onehot_reads,
+            "platform": "cpu",
+            "stage": "optimized HLO (compiled.as_text())",
+        },
+        "budget": {op.replace("-", "_"): measured[op.replace("-", "_")]
+                   for op in GATED_OPS},
+        "observed": measured,
+        "note": ("Budgets gate gather/scatter/while; counts are "
+                 "group-count-independent.  Update via scripts/lint.py "
+                 "--reseed-hlo-budget + a PERF.md note justifying the "
+                 "change."),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(spec, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return spec

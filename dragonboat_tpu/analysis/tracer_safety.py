@@ -1,0 +1,515 @@
+"""Tracer-safety lint: host control flow and host syncs in jitted scope.
+
+Walks every function reachable from a ``jax.jit`` / ``jax.vmap`` /
+``lax.scan`` / ``lax.fori_loop`` / ``shard_map`` / ``pallas_call`` seed
+site in the kernel module set and flags, inside that traced scope:
+
+- TS001  Python ``if`` / ``while`` (or conditional expression) whose test
+         depends on a traced value — under tracing this either raises
+         ``TracerBoolConversionError`` or silently bakes one branch into
+         the executable;
+- TS002  ``int()`` / ``float()`` / ``bool()`` / ``.item()`` / ``.tolist()``
+         on a traced value (host coercion, same failure class);
+- TS003  host syncs: ``np.asarray`` / ``np.array`` on a traced value,
+         ``.block_until_ready()``, ``jax.device_get``;
+- TS004  ``time.*`` / ``random.*`` / ``datetime.*`` / ``np.random.*`` —
+         host-side effects that trace once at compile time and then
+         freeze (a bench or kernel that "randomizes" per step this way
+         measures one constant forever).
+
+Traced-ness is a forward single-pass taint over each function body:
+parameters are tainted unless they are jit-static for that function
+(``static_argnums`` on its own decorator, or a name in
+``STATIC_PARAM_NAMES`` — the repo's conventional static-argument
+spellings, see that constant), and any ``jnp.*`` / ``jax.*`` result is
+tainted.  Shape metadata (``.shape`` / ``.ndim`` / ``.dtype`` /
+``.size`` / ``len()`` / ``isinstance()`` / ``x is None``) sanitizes, so
+the kernel's static specialization branches (``if kp.onehot_reads:``,
+``if x is None:``) stay clean by construction, not by waiver.
+
+The pass is intra-module-set: calls are resolved through plain names,
+``from m import f`` aliases, and ``mod.f`` attributes against the
+scanned file set; anything it cannot resolve is assumed host-side and
+not descended into (its *result* is still tainted when its arguments
+are).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from dragonboat_tpu.analysis.common import Finding, rel
+
+PASS = "tracer-safety"
+
+# Modules whose jit/vmap call sites seed the traced-scope walk, plus the
+# helper modules their kernels call into.
+DEFAULT_MODULES = (
+    "dragonboat_tpu/core/kernel.py",
+    "dragonboat_tpu/core/router.py",
+    "dragonboat_tpu/core/kstate.py",
+    "dragonboat_tpu/core/params.py",
+    "dragonboat_tpu/rsm/device_kv.py",
+    "dragonboat_tpu/rsm/device_kv_pallas.py",
+    "dragonboat_tpu/parallel/ici.py",
+    "dragonboat_tpu/bench_loop.py",
+)
+
+# Conventional static-argument names in this repo: every jit site passes
+# these via static_argnums, and the helpers thread them under the same
+# spellings.  A name listed here is never treated as traced.
+STATIC_PARAM_NAMES = frozenset({
+    "self",          # DeviceKV methods: frozen dataclass via static_argnums=0
+    "kp", "kv", "cluster", "family", "replicas", "iters",
+    "write_width", "do_reads", "R", "n_local", "axis",
+    "T", "D", "AB", "hash_keys", "interpret", "unroll",
+})
+
+# Attribute reads that yield static metadata, never a tracer.
+META_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "at",
+                        "aval", "weak_type"})
+
+# Builtins whose result is host/static regardless of argument taint.
+CLEAN_FUNCS = frozenset({"len", "isinstance", "type", "hasattr", "getattr",
+                         "range", "zip", "enumerate", "sorted", "min", "max",
+                         "tuple", "list", "dict", "set", "repr", "str",
+                         "issubclass", "callable", "id"})
+
+COERCE_FUNCS = frozenset({"int", "float", "bool", "complex"})
+COERCE_METHODS = frozenset({"item", "tolist"})
+SYNC_METHODS = frozenset({"block_until_ready", "copy_to_host_async"})
+HOST_EFFECT_MODULES = frozenset({"time", "random", "datetime"})
+
+# Call sites whose function-valued arguments enter traced scope.
+TRACING_CALLS = frozenset({
+    "jit", "vmap", "pmap", "scan", "fori_loop", "while_loop", "cond",
+    "switch", "shard_map", "pallas_call", "checkpoint", "remat", "custom_vjp",
+    "associative_scan", "map", "grad", "value_and_grad",
+})
+# ...except: plain builtin map() is not a tracing site; only lax.map is.
+BARE_NAME_TRACING = TRACING_CALLS - {"map", "jit", "grad"}
+
+
+def _callee_names(call: ast.Call) -> list[str]:
+    """Function names referenced by a call argument (unwraps partial)."""
+    out = []
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        out.extend(_func_refs(a))
+    return out
+
+
+def _func_refs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Call):
+        # functools.partial(f, ...) / jax.vmap(f) nesting
+        return [n for a in [node.func] + list(node.args)
+                for n in _func_refs(a)]
+    if isinstance(node, ast.Lambda):
+        return []          # analyzed in place as part of the enclosing scope
+    return []
+
+
+def _call_basename(func: ast.AST) -> str | None:
+    """`jax.lax.scan` -> "scan", `vmap` -> "vmap"."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _Module:
+    def __init__(self, path: str, tree: ast.Module) -> None:
+        self.path = path
+        self.tree = tree
+        self.funcs: dict[str, ast.FunctionDef] = {}
+        self.imports: dict[str, str] = {}   # local alias -> imported name
+        self.aliases: dict[str, set[str]] = {}  # container -> funcs inside
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, node)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.imports[a.asname or a.name] = a.name
+        # module-level dispatch tables (e.g. _FAMILY_HANDLERS): a traced
+        # function referencing the container calls everything inside it
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                refs = {n.id for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name) and n.id in self.funcs}
+                if refs:
+                    self.aliases[node.targets[0].id] = refs
+
+
+def _static_argnum_names(fn: ast.FunctionDef) -> set[str]:
+    """Parameter names pinned static by the function's own jit decorator."""
+    names: set[str] = set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            try:
+                val = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            items = val if isinstance(val, (tuple, list)) else (val,)
+            for it in items:
+                if isinstance(it, int) and it < len(params):
+                    names.add(params[it])
+                elif isinstance(it, str):
+                    names.add(it)
+    return names
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        for name in _func_refs(dec):
+            if name in ("jit", "vmap", "pmap"):
+                return True
+    return False
+
+
+class _FunctionLinter(ast.NodeVisitor):
+    """Single forward taint pass over one traced top-level function."""
+
+    def __init__(self, mod: _Module, fn: ast.FunctionDef,
+                 findings: list[Finding], relpath: str) -> None:
+        self.mod = mod
+        self.findings = findings
+        self.relpath = relpath
+        self.tainted: set[str] = set()
+        self._flagged_lines: set[tuple[int, str]] = set()
+        self._bind_params(fn)
+
+    # -- parameter and name binding -------------------------------------
+    def _bind_params(self, fn: ast.FunctionDef | ast.Lambda) -> None:
+        static = STATIC_PARAM_NAMES | (
+            _static_argnum_names(fn) if isinstance(fn, ast.FunctionDef)
+            else set())
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in static:
+                self.tainted.add(a.arg)
+
+    def _bind_target(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind_target(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, tainted)
+        # attribute/subscript stores don't create local names
+
+    # -- reporting ------------------------------------------------------
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        key = (node.lineno, rule)
+        if key in self._flagged_lines:
+            return
+        self._flagged_lines.add(key)
+        self.findings.append(Finding(PASS, self.relpath, node.lineno,
+                                     rule, msg))
+
+    # -- taint evaluation (with side-effect flagging of bad calls) ------
+    def _taint(self, node: ast.AST | None) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return False
+            return self._taint(node.value)
+        if isinstance(node, ast.Call):
+            return self._taint_call(node)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                # identity tests are host decisions (x is None)
+                for sub in [node.left] + node.comparators:
+                    self._taint(sub)   # still surface bad calls inside
+                return False
+            return any(self._taint(x)
+                       for x in [node.left] + node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self._taint(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self._taint(node.left) | self._taint(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._taint(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self._taint(node.value) | self._taint(node.slice)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._taint(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._taint(x)
+                       for x in list(node.keys) + list(node.values) if x)
+        if isinstance(node, ast.IfExp):
+            if self._taint(node.test):
+                self._flag(node, "TS001",
+                           "conditional expression on a traced value")
+            return self._taint(node.body) | self._taint(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self._taint(node.value)
+        if isinstance(node, ast.Lambda):
+            # analyzed when called at a tracing site; the object is clean
+            return False
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            t = False
+            for gen in node.generators:
+                it = self._taint(gen.iter)
+                self._bind_target(gen.target, it)
+                t |= it
+            if isinstance(node, ast.DictComp):
+                return t | self._taint(node.key) | self._taint(node.value)
+            return t | self._taint(node.elt)
+        if isinstance(node, ast.JoinedStr):
+            return any(self._taint(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self._taint(node.value)
+        if isinstance(node, ast.Slice):
+            return (self._taint(node.lower) | self._taint(node.upper)
+                    | self._taint(node.step))
+        if isinstance(node, ast.NamedExpr):
+            t = self._taint(node.value)
+            self._bind_target(node.target, t)
+            return t
+        return False   # unknown node kinds: assume host-static
+
+    def _root_module(self, node: ast.AST) -> str | None:
+        """`time.monotonic` -> "time"; `np.random.rand` -> "np.random"."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            parts.reverse()
+            return ".".join(parts[:-1]) if len(parts) > 1 else None
+        return None
+
+    def _taint_call(self, node: ast.Call) -> bool:
+        func = node.func
+        args_tainted = any(self._taint(a) for a in node.args) or any(
+            self._taint(k.value) for k in node.keywords)
+
+        if isinstance(func, ast.Name):
+            if func.id in CLEAN_FUNCS:
+                return False
+            if func.id in COERCE_FUNCS and args_tainted:
+                self._flag(node, "TS002",
+                           f"{func.id}() on a traced value forces a host "
+                           "sync / concretization inside jitted scope")
+                return False
+        if isinstance(func, ast.Attribute):
+            root = self._root_module(func)
+            if root in HOST_EFFECT_MODULES or root in (
+                    "np.random", "numpy.random"):
+                self._flag(node, "TS004",
+                           f"{root}.{func.attr}() inside traced scope "
+                           "executes once at trace time and freezes")
+                return False
+            if func.attr in COERCE_METHODS and self._taint(func.value):
+                self._flag(node, "TS002",
+                           f".{func.attr}() on a traced value")
+                return False
+            if func.attr in SYNC_METHODS:
+                self._flag(node, "TS003",
+                           f".{func.attr}() host sync inside traced scope")
+                return False
+            if func.attr == "device_get":
+                self._flag(node, "TS003",
+                           "jax.device_get() inside traced scope")
+                return False
+            if root in ("np", "numpy") and func.attr in (
+                    "asarray", "array") and args_tainted:
+                self._flag(node, "TS003",
+                           f"{root}.{func.attr}() on a traced value pulls "
+                           "the buffer to host")
+                return False
+            if root is not None and root.split(".")[0] in (
+                    "jnp", "jax", "lax", "plax", "pl"):
+                return True        # jax-family result: a tracer
+            if self._taint(func.value):
+                return True        # method on a tracer yields a tracer
+        # helper call: traced result iff any traced argument flowed in
+        return args_tainted
+
+    # -- statements -----------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        t = self._taint(node.value)
+        for tgt in node.targets:
+            self._bind_target(tgt, t)
+            self._taint(tgt)       # flag bad calls in subscript targets
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._bind_target(node.target, self._taint(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        t = self._taint(node.value) or self._taint(node.target)
+        self._bind_target(node.target, t)
+
+    def _isinstance_narrowed(self, test: ast.AST) -> set[str]:
+        """Names proven host-typed by an ``isinstance(x, ...)`` test."""
+        if (isinstance(test, ast.Call) and isinstance(test.func, ast.Name)
+                and test.func.id == "isinstance" and test.args
+                and isinstance(test.args[0], ast.Name)):
+            return {test.args[0].id}
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            out: set[str] = set()
+            for v in test.values:
+                out |= self._isinstance_narrowed(v)
+            return out
+        return set()
+
+    def visit_If(self, node: ast.If) -> None:
+        if self._taint(node.test):
+            self._flag(node, "TS001",
+                       "Python `if` on a traced value inside jitted scope "
+                       "(use jnp.where / lax.cond)")
+        narrowed = self._isinstance_narrowed(node.test) & self.tainted
+        self.tainted -= narrowed
+        for st in node.body:
+            self.visit(st)
+        self.tainted |= narrowed
+        for st in node.orelse:
+            self.visit(st)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._taint(node.test):
+            self._flag(node, "TS001",
+                       "Python `while` on a traced value inside jitted "
+                       "scope (use lax.while_loop)")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        it = self._taint(node.iter)
+        # dict-structure iteration is static control flow (the key set is
+        # a trace-time constant) even when the VALUES are tracers
+        if (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Attribute)
+                and node.iter.func.attr in ("items", "keys", "values")):
+            self._bind_target(node.target, it)
+            self.generic_visit(node)
+            return
+        if it:
+            self._flag(node, "TS001",
+                       "Python `for` over a traced value inside jitted "
+                       "scope (use lax.scan / fori_loop)")
+        self._bind_target(node.target, it)
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        self._taint(node.value)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self._taint(node.value)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._taint(node.test)     # surface bad calls; asserts themselves ok
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs (scan/fori bodies, routers' closures) are traced
+        # with the parent; their params are fresh tracers
+        self._bind_params(node)
+        for st in node.body:
+            self.visit(st)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._bind_params(node)
+        self._taint(node.body)
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        for st in fn.body:
+            self.visit(st)
+        # lambdas appearing in expression statements are visited via
+        # _taint -> visit? no: evaluate them explicitly
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Lambda):
+                self.visit_Lambda(sub)
+
+
+def _seed_and_calls(mod: _Module) -> tuple[set[str], dict[str, set[str]]]:
+    """(traced seed function names, per-function called-name sets)."""
+    seeds: set[str] = set()
+    calls: dict[str, set[str]] = {name: set() for name in mod.funcs}
+
+    for name, fn in mod.funcs.items():
+        if _is_jit_decorated(fn):
+            seeds.add(name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in mod.aliases:
+                calls[name].update(mod.aliases[node.id])
+            if not isinstance(node, ast.Call):
+                continue
+            base = _call_basename(node.func)
+            refs = _func_refs(node.func) + _callee_names(node)
+            calls[name].update(
+                n for n in refs if n in mod.funcs or n in mod.imports)
+            if base in TRACING_CALLS and (
+                    isinstance(node.func, ast.Attribute)
+                    or base in BARE_NAME_TRACING):
+                for ref in _callee_names(node):
+                    if ref not in TRACING_CALLS and ref != "partial":
+                        seeds.add(ref)
+    return seeds, calls
+
+
+def run(root: str, files: list[str] | None = None) -> list[Finding]:
+    paths = files if files is not None else [
+        os.path.join(root, m) for m in DEFAULT_MODULES]
+    mods: list[_Module] = []
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            mods.append(_Module(p, ast.parse(f.read(), filename=p)))
+
+    # global name -> (module, fn): resolve `from m import f` across the set
+    global_funcs: dict[str, tuple[_Module, ast.FunctionDef]] = {}
+    for m in mods:
+        for name, fn in m.funcs.items():
+            global_funcs.setdefault(name, (m, fn))
+
+    # seed + propagate reachability over the whole set
+    traced: set[str] = set()
+    all_calls: dict[str, set[str]] = {}
+    for m in mods:
+        seeds, calls = _seed_and_calls(m)
+        traced |= seeds
+        for name, callees in calls.items():
+            all_calls.setdefault(name, set()).update(
+                m.imports.get(c, c) for c in callees)
+
+    frontier = list(traced)
+    while frontier:
+        name = frontier.pop()
+        for callee in all_calls.get(name, ()):
+            if callee in global_funcs and callee not in traced:
+                traced.add(callee)
+                frontier.append(callee)
+
+    findings: list[Finding] = []
+    for name in sorted(traced):
+        if name not in global_funcs:
+            continue
+        mod, fn = global_funcs[name]
+        linter = _FunctionLinter(mod, fn, findings, rel(root, mod.path))
+        linter.run(fn)
+    # nested defs are analyzed both standalone and within their parent
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))

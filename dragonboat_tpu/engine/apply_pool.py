@@ -25,12 +25,12 @@ class ApplyPool:
                  on_work_done: Callable[[], None] | None = None,
                  name: str = "apply") -> None:
         self._cv = threading.Condition()
-        self._queues: dict[object, deque] = {}
-        self._ready: deque = deque()      # keys with work, not being run
-        self._running: set = set()
-        self._stopped = False
+        self._queues: dict[object, deque] = {}   # guarded-by: _cv
+        self._ready: deque = deque()             # guarded-by: _cv — keys with work, not being run
+        self._running: set = set()               # guarded-by: _cv
+        self._stopped = False                    # guarded-by: _cv
         self._on_work_done = on_work_done
-        self._threads = []
+        self._threads = []                       # guarded-by: <init-only>
         for i in range(max(1, num_workers)):
             t = threading.Thread(target=self._worker_main,
                                  name=f"{name}-{i}", daemon=True)

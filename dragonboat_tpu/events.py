@@ -33,7 +33,7 @@ class Metrics:
 
     def __init__(self) -> None:
         self.mu = threading.Lock()
-        self.counters: dict[str, int] = defaultdict(int)
+        self.counters: dict[str, int] = defaultdict(int)   # guarded-by: mu
 
     def inc(self, name: str, delta: int = 1) -> None:
         with self.mu:

@@ -33,6 +33,23 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
+try:
+    # jax >= 0.6: top-level shard_map, replication check kwarg is
+    # check_vma
+    _shard_map_impl = jax.shard_map
+    _SHARD_MAP_NOCHECK = {"check_vma": False}
+except AttributeError:
+    # jax 0.4/0.5: experimental namespace, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _SHARD_MAP_NOCHECK = {"check_rep": False}
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map(..., check_vma=False)` across jax versions."""
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **_SHARD_MAP_NOCHECK)
+
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.kernel import step
 from dragonboat_tpu.core.kstate import (
@@ -139,12 +156,11 @@ def _ici_body(kp: KP.KernelParams, replicas: int,
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _jit_ici_step(kp, cluster: IciCluster, state, box, inp):
-    body = jax.shard_map(
+    body = shard_map(
         functools.partial(_ici_body, kp, cluster.replicas),
         mesh=cluster.mesh,
         in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
         out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
-        check_vma=False,
     )
     return body(state, box, inp)
 
@@ -202,13 +218,12 @@ def _serve_body(kp: KP.KernelParams, replicas: int,
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _jit_serve_step(kp, cluster: IciCluster, state, box, inp, cut):
-    body = jax.shard_map(
+    body = shard_map(
         functools.partial(_serve_body, kp, cluster.replicas),
         mesh=cluster.mesh,
         in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")),
                   PS(("g", "r"))),
         out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")), PS()),
-        check_vma=False,
     )
     return body(state, box, inp, cut)
 
@@ -259,10 +274,9 @@ def ici_run_steps(kp, cluster: IciCluster, iters: int, propose: bool,
             0, iters, lambda _, c: one(*c), (st, bx)
         )
 
-    return jax.shard_map(
+    return shard_map(
         sharded,
         mesh=cluster.mesh,
         in_specs=(PS(("g", "r")), PS(("g", "r"))),
         out_specs=(PS(("g", "r")), PS(("g", "r"))),
-        check_vma=False,
     )(state, box)

@@ -147,10 +147,10 @@ class PendingProposal(_ClockedBook):
     def __init__(self, shards: int = 8,
                  clock: LogicalClock | None = None) -> None:
         super().__init__(clock)
-        self._shards: list[dict[int, RequestState]] = [
+        self._shards: list[dict[int, RequestState]] = [   # guarded-by: _locks
             {} for _ in range(shards)]
         self._locks = [threading.Lock() for _ in range(shards)]
-        self._n = shards
+        self._n = shards                                  # guarded-by: <init-only>
 
     @property
     def pending(self) -> dict[int, RequestState]:
@@ -232,10 +232,10 @@ class PendingReadIndex(_ClockedBook):
 
     def __init__(self, clock: LogicalClock | None = None) -> None:
         super().__init__(clock)
-        self.pending: dict[int, list[RequestState]] = {}   # ctx_low -> readers
-        self.batching: list[RequestState] = []
-        self.ready: dict[int, int] = {}                    # ctx_low -> index
-        self.waiting: list[tuple[int, RequestState]] = []  # (index, rs)
+        self.pending: dict[int, list[RequestState]] = {}   # guarded-by: mu — ctx_low -> readers
+        self.batching: list[RequestState] = []             # guarded-by: mu
+        self.ready: dict[int, int] = {}                    # guarded-by: mu — ctx_low -> index
+        self.waiting: list[tuple[int, RequestState]] = []  # guarded-by: mu — (index, rs)
 
     def read(self, timeout_ticks: int) -> RequestState:
         rs = RequestState(deadline_tick=self.tick + timeout_ticks)
@@ -332,8 +332,8 @@ class PendingSingleton(_ClockedBook):
     def __init__(self, clock: LogicalClock | None = None) -> None:
         super().__init__(clock)
         self.key_seq = itertools.count(1)
-        self.outstanding: RequestState | None = None
-        self.key = 0
+        self.outstanding: RequestState | None = None       # guarded-by: mu
+        self.key = 0                                       # guarded-by: mu
 
     def request(self, timeout_ticks: int) -> tuple[RequestState, int]:
         with self.mu:

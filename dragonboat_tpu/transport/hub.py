@@ -30,8 +30,8 @@ class CircuitBreaker:
     """Minimal failure breaker (transport.go GetCircuitBreaker)."""
 
     def __init__(self, reset_after: float = BREAKER_RESET_SECONDS) -> None:
-        self.reset_after = reset_after
-        self.tripped_at = 0.0
+        self.reset_after = reset_after        # guarded-by: <init-only>
+        self.tripped_at = 0.0                 # guarded-by: mu
         self.mu = threading.Lock()
 
     def ready(self) -> bool:
@@ -68,8 +68,8 @@ class TransportHub:
         # shared snapshot-bandwidth bucket: the bytes/s cap is per HOST,
         # so concurrent streams draw from one budget
         self._snap_mu = threading.Lock()
-        self._snap_sent = 0
-        self._snap_start = 0.0
+        self._snap_sent = 0                   # guarded-by: _snap_mu
+        self._snap_start = 0.0                # guarded-by: _snap_mu
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.transport = transport
@@ -78,12 +78,12 @@ class TransportHub:
         self.sync = sync
         self.events = events if events is not None else EventHub()
         self.mu = threading.Lock()
-        self.queues: dict[str, deque[tuple[pb.Message, int]]] = {}
-        self.queue_bytes: dict[str, int] = {}
-        self.breakers: dict[str, CircuitBreaker] = {}
+        self.queues: dict[str, deque[tuple[pb.Message, int]]] = {}  # guarded-by: mu
+        self.queue_bytes: dict[str, int] = {}                       # guarded-by: mu
+        self.breakers: dict[str, CircuitBreaker] = {}               # guarded-by: mu
         # (addr, snapshot) -> last observed connection state; edge-triggered
         # listener events fire only on state changes (and first observation)
-        self.connected: dict[tuple[str, bool], bool] = {}
+        self.connected: dict[tuple[str, bool], bool] = {}           # guarded-by: mu
         # counters live in the shared process-wide registry (events.Metrics)
         self.metrics = self.events.metrics
 

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Static-analysis runner: the four lint passes over the repo.
+
+Passes (dragonboat_tpu/analysis/):
+
+  tracer-safety   Python control flow / host coercions on traced values
+                  in every function reachable from a jit/vmap call site
+  hlo-budget      optimized-HLO gather/scatter/while counts of the step
+                  kernel vs the checked-in analysis/hlo_budget.json
+  concurrency     `# guarded-by:` annotation discipline on shared
+                  mutable state in the threaded modules
+  determinism     wall clock / unseeded RNG / set-iteration order in
+                  the core/ and rsm/ replay paths
+
+Exit status is non-zero iff any unwaived finding remains.  Waivers live
+in dragonboat_tpu/analysis/waivers.toml; waived findings are still
+printed (with their reasons) so suppressions stay visible.
+
+The hlo-budget pass compiles the bench kernel (~10 s on CPU); skip it
+during tight edit loops with `--pass` selecting the AST passes, or
+refresh its budget after a justified kernel change with
+`--reseed-hlo-budget` (then record why in PERF.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# lowering must never grab a TPU just to count ops
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from dragonboat_tpu.analysis import (  # noqa: E402
+    common,
+    concurrency,
+    determinism,
+    hlo_budget,
+    tracer_safety,
+)
+
+PASSES = {
+    "tracer-safety": tracer_safety.run,
+    "concurrency": concurrency.run,
+    "determinism": determinism.run,
+    "hlo-budget": hlo_budget.run,
+}
+
+WAIVERS_FILE = "dragonboat_tpu/analysis/waivers.toml"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=sorted(PASSES),
+                    help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--reseed-hlo-budget", action="store_true",
+                    help="re-measure the kernel and overwrite "
+                         "analysis/hlo_budget.json (justify in PERF.md)")
+    args = ap.parse_args(argv)
+
+    if args.reseed_hlo_budget:
+        spec = hlo_budget.reseed(ROOT)
+        print(f"reseeded {hlo_budget.BUDGET_FILE}:")
+        print(json.dumps(spec["budget"], indent=2, sort_keys=True))
+        return 0
+
+    try:
+        waivers = common.load_waivers(os.path.join(ROOT, WAIVERS_FILE))
+    except common.WaiverError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    selected = args.passes or sorted(PASSES)
+    unwaived: list[common.Finding] = []
+    waived: list[tuple[common.Finding, common.Waiver]] = []
+    for name in selected:
+        findings = PASSES[name](ROOT)
+        u, w = common.apply_waivers(findings, waivers)
+        unwaived += u
+        waived += w
+        if not args.json:
+            print(f"== {name}: {len(u)} finding(s), {len(w)} waived ==")
+            for f in u:
+                print(f"  {f.format()}")
+            for f, wv in w:
+                print(f"  [waived: {wv.reason}] {f.format()}")
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in unwaived],
+            "waived": [{"finding": f.__dict__, "reason": wv.reason}
+                       for f, wv in waived],
+        }, indent=2))
+    elif unwaived:
+        print(f"\nFAIL: {len(unwaived)} unwaived finding(s)")
+    else:
+        print("\nOK: no unwaived findings")
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

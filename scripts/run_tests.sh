@@ -19,6 +19,13 @@ run() {
     echo "== chunk: $* =="
     PYTHONPATH= "$PY" -m pytest "$@" -q || rc=$?
 }
+# fast pre-test stage: the four static-analysis passes (scripts/lint.py;
+# ~10 s, dominated by one hlo-budget compile at G=64).  After a
+# justified kernel change that shifts the gather/scatter/while counts:
+# `python scripts/lint.py --reseed-hlo-budget`, review the
+# analysis/hlo_budget.json diff, and record why in PERF.md.
+echo "== lint =="
+PYTHONPATH= "$PY" scripts/lint.py || rc=$?
 run tests/test_zz_kernel_scale.py tests/test_zz_mesh_scale.py
 run tests/test_a*.py tests/test_b*.py tests/test_d*.py tests/test_e*.py \
     tests/test_f*.py tests/test_g*.py tests/test_h*.py tests/test_k*.py

@@ -306,9 +306,14 @@ def _make_certs(d):
             "-keyout", key, "-out", csr, "-subj", "/CN=node",
             "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
            check=True, capture_output=True)
+    # -copy_extensions needs openssl 3; an -extfile with the same SAN
+    # works on 1.1 and 3 alike
+    extfile = f"{d}/san.cnf"
+    with open(extfile, "w") as f:
+        f.write("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
     sp.run(["openssl", "x509", "-req", "-in", csr, "-CA", ca_crt,
             "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1",
-            "-copy_extensions", "copyall"],
+            "-extfile", extfile],
            check=True, capture_output=True)
     return ca_crt, crt, key
 
