@@ -1,18 +1,25 @@
 """Project-native static analysis (``scripts/lint.py``).
 
-Four passes guard the invariants the test suite cannot watch directly:
+Five passes guard the invariants the test suite cannot watch directly:
 
 - ``tracer_safety``  — no host control flow / host syncs inside jitted scope
   (the branchless-kernel contract, core/kernel.py);
 - ``hlo_budget``     — the lowered step kernel stays within the checked-in
   gather/scatter/while budget (``hlo_budget.json``; the r5 155->32
-  gather prune, PERF.md, as a permanent gate);
+  gather prune, PERF.md, as a permanent gate; result cached by source
+  hash in ``.hlo_budget_cache.json``);
 - ``concurrency``    — ``# guarded-by: <lock>`` discipline on mutable
-  attributes of classes shared across threads;
+  attributes of classes shared across threads, plus the CC003
+  lock-order graph (static deadlock detection);
 - ``determinism``    — no wall-clock, unseeded RNG, or set-iteration-order
-  dependence in the core/ and rsm/ replay paths.
+  dependence in the core/ and rsm/ replay paths;
+- ``contracts``      — machine-checked shape/dtype/domain/ring-mask
+  contracts over the batched Raft step: an abstract interpreter over
+  core/kernel.py checks the CONTRACTS declarations of core/kstate.py,
+  and an eval_shape pass diffs declared vs actual structures.
 
 Pre-existing violations are either fixed or waived in ``waivers.toml``
-with a one-line reason.  Each pass exposes ``run(root, files=None)``
-returning ``list[common.Finding]`` so tests can point it at fixtures.
+with a one-line reason (stale waivers are themselves lint failures).
+Each pass exposes ``run(root, files=None)`` returning
+``list[common.Finding]`` so tests can point it at fixtures.
 """

@@ -37,6 +37,7 @@ class Waiver:
     rule: str | None = None
     contains: str | None = None    # substring of the finding message
     hits: int = field(default=0, compare=False)
+    line: int = field(default=0, compare=False)  # [[waiver]] line in the toml
 
     def matches(self, f: Finding) -> bool:
         if self.pass_name != f.pass_name:
@@ -63,7 +64,7 @@ def _parse_toml_subset(text: str, where: str) -> list[dict]:
         if not line or line.startswith("#"):
             continue
         if line == "[[waiver]]":
-            cur = {}
+            cur = {"__line__": n}
             tables.append(cur)
             continue
         if "=" in line and cur is not None:
@@ -97,7 +98,8 @@ def load_waivers(path: str) -> list[Waiver]:
             raise WaiverError(f"waiver #{i + 1}: empty reason")
         waivers.append(Waiver(pass_name=t["pass_name"], path=t["path"],
                               reason=t["reason"], rule=t.get("rule"),
-                              contains=t.get("contains")))
+                              contains=t.get("contains"),
+                              line=t.get("__line__", 0)))
     return waivers
 
 
@@ -119,3 +121,124 @@ def apply_waivers(findings: list[Finding], waivers: list[Waiver]
 
 def rel(root: str, path: str) -> str:
     return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# Field-contract grammar (core/kstate.py CONTRACTS) + the tiny shape/dtype
+# lattice the contracts pass interprets over.  Kept here so tests and any
+# future pass share one parser.
+# ---------------------------------------------------------------------------
+
+#: canonical dtype names used throughout the contracts pass
+DTYPES = ("i32", "u32", "f32", "bool")
+
+
+class ContractError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class FieldContract:
+    """One parsed ``"[G, P] i32 domain=A..B ring optional"`` string."""
+
+    axes: tuple[str, ...]          # symbolic axis names, () = scalar
+    dtype: str                     # one of DTYPES
+    ring: bool = False             # power-of-two ring: indexing must mask
+    optional: bool = False         # field may be None under some configs
+    domain: tuple[str, str] | None = None  # (lo_name, hi_name) in params.py
+
+
+def parse_contract(spec: str, where: str = "<contract>") -> FieldContract:
+    """Parse one contract string; raises ContractError on bad grammar."""
+    s = spec.strip()
+    if not s.startswith("["):
+        raise ContractError(f"{where}: contract must start with [axes]: "
+                            f"{spec!r}")
+    end = s.find("]")
+    if end < 0:
+        raise ContractError(f"{where}: unterminated axis list: {spec!r}")
+    axes_src = s[1:end].strip()
+    axes = tuple(a.strip() for a in axes_src.split(",") if a.strip())
+    rest = s[end + 1:].split()
+    if not rest:
+        raise ContractError(f"{where}: missing dtype: {spec!r}")
+    dtype, tags = rest[0], rest[1:]
+    if dtype not in DTYPES:
+        raise ContractError(f"{where}: unknown dtype {dtype!r} "
+                            f"(want one of {DTYPES}): {spec!r}")
+    ring = optional = False
+    domain = None
+    for t in tags:
+        if t == "ring":
+            ring = True
+        elif t == "optional":
+            optional = True
+        elif t.startswith("domain="):
+            lo, sep, hi = t[len("domain="):].partition("..")
+            if not sep or not lo or not hi:
+                raise ContractError(f"{where}: bad domain tag {t!r} "
+                                    "(want domain=LO..HI)")
+            domain = (lo, hi)
+        else:
+            raise ContractError(f"{where}: unknown tag {t!r}: {spec!r}")
+    return FieldContract(axes=axes, dtype=dtype, ring=ring,
+                         optional=optional, domain=domain)
+
+
+def parse_contracts(table: dict, where: str = "<contracts>"
+                    ) -> dict[str, dict[str, FieldContract]]:
+    """Parse a ``{"Class": {"field": "spec", ...}, ...}`` literal."""
+    out: dict[str, dict[str, FieldContract]] = {}
+    for cls, fields in table.items():
+        out[cls] = {
+            name: parse_contract(spec, f"{where}:{cls}.{name}")
+            for name, spec in fields.items()
+        }
+    return out
+
+
+def broadcast_axes(a: tuple[str, ...] | None, b: tuple[str, ...] | None
+                   ) -> tuple[tuple[str, ...] | None, str | None]:
+    """NumPy trailing-aligned broadcast over NAMED axes.
+
+    Axis entries are axis names, ``'1'`` (unit, broadcasts into anything)
+    or ``'?'`` (unknown extent, unifies with anything).  ``None`` means a
+    fully unknown rank/shape.  Returns ``(result_axes, conflict)`` where
+    ``conflict`` is a human-readable description of the first pair of
+    distinct named axes forced into alignment, or ``None`` if the
+    broadcast is clean.
+    """
+    if a is None and b is None:
+        return None, None
+    if a is None or b is None:
+        # unknown rank/shape unifies with the known side (optimistic:
+        # the lattice never flags what it cannot see)
+        return (b if a is None else a), None
+    out: list[str] = []
+    conflict = None
+    for i in range(1, max(len(a), len(b)) + 1):
+        x = a[-i] if i <= len(a) else "1"
+        y = b[-i] if i <= len(b) else "1"
+        if x == y:
+            out.append(x)
+        elif x == "1":
+            out.append(y)
+        elif y == "1":
+            out.append(x)
+        elif x == "?" or y == "?":
+            out.append(y if x == "?" else x)
+        else:
+            # two distinct NAMED axes aligned — the broadcast "works"
+            # numerically whenever the extents happen to agree (K == E
+            # == B == RI == 8 in the default geometry), which is exactly
+            # the silent cross-axis bug this lattice exists to catch.
+            conflict = f"axis {x!r} vs {y!r} at dim -{i}"
+            out.append("?")
+    return tuple(reversed(out)), conflict
+
+
+def join_dtypes(a: str | None, b: str | None) -> str | None:
+    """Lattice join for ``jnp.where``-style merges: agree or unknown."""
+    if a is None or b is None:
+        return None
+    return a if a == b else None

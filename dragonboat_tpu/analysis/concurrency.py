@@ -17,11 +17,22 @@ threads.  Two rules:
          key-sharded book pattern (request.py PendingProposal).
          ``init-only`` attributes admit no post-``__init__`` mutation
          at all.
+- CC003  static deadlock detection: a lock-order graph is built per
+         class with an edge A -> B whenever ``self.B`` is acquired
+         (directly, or transitively through a same-class method call)
+         while ``self.A`` is held.  A cycle in that graph — including
+         the length-1 cycle of re-acquiring a non-reentrant
+         Lock/Semaphore — means two threads interleaving those paths
+         can deadlock, and is flagged at one acquisition site per
+         cycle edge.
 
-Known limitation (documented, on purpose): mutations through a local
+Known limitations (documented, on purpose): mutations through a local
 alias (``q = self.queues[a]; q.append(...)``) are not tracked — the
 lint enforces the annotation discipline at the ``self.<attr>`` access
-level, which is where review happens.
+level, which is where review happens.  The lock-order graph is
+likewise per-class and ``self.``-scoped: an inversion spanning two
+objects' locks (hub holding its mu while calling into a pool that
+grabs its own) needs runtime lock profiling, not this lint.
 """
 
 from __future__ import annotations
@@ -55,6 +66,9 @@ _GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_<][A-Za-z0-9_\->]*)")
 
 INIT_ONLY = "<init-only>"
 
+# acquiring one of these twice on the same thread is safe
+REENTRANT_CTORS = frozenset({"RLock", "Condition"})
+
 
 def _ctor_name(node: ast.AST) -> str | None:
     """`threading.Lock()` -> "Lock"; `deque()` -> "deque"."""
@@ -68,16 +82,24 @@ def _ctor_name(node: ast.AST) -> str | None:
     return None
 
 
-def _is_lock_value(node: ast.AST) -> bool:
-    if _ctor_name(node) in LOCK_CTORS:
-        return True
+def _lock_kind(node: ast.AST) -> str | None:
+    """The lock ctor name when ``node`` builds a lock (or lock array)."""
+    name = _ctor_name(node)
+    if name in LOCK_CTORS:
+        return name
     # [threading.Lock() for _ in range(n)] — a lock *array*
-    if isinstance(node, ast.ListComp) and _ctor_name(node.elt) in LOCK_CTORS:
-        return True
+    if isinstance(node, ast.ListComp):
+        name = _ctor_name(node.elt)
+        if name in LOCK_CTORS:
+            return name
     if isinstance(node, (ast.List, ast.Tuple)) and node.elts and all(
             _ctor_name(e) in LOCK_CTORS for e in node.elts):
-        return True
-    return False
+        return _ctor_name(node.elts[0])
+    return None
+
+
+def _is_lock_value(node: ast.AST) -> bool:
+    return _lock_kind(node) is not None
 
 
 def _is_mutable_value(node: ast.AST) -> bool:
@@ -107,6 +129,7 @@ class _ClassInfo:
     def __init__(self, cls: ast.ClassDef, src_lines: list[str]) -> None:
         self.cls = cls
         self.locks: set[str] = set()
+        self.lock_kinds: dict[str, str] = {}  # attr -> ctor name
         self.guards: dict[str, str] = {}   # attr -> lock name / INIT_ONLY
         self.mutable_unannotated: list[tuple[str, int]] = []
         init = next((n for n in cls.body
@@ -127,8 +150,10 @@ class _ClassInfo:
                 attr = _self_attr(tgt)
                 if attr is None:
                     continue
-                if _is_lock_value(value):
+                kind = _lock_kind(value)
+                if kind is not None:
                     self.locks.add(attr)
+                    self.lock_kinds[attr] = kind
                     continue
                 m = _GUARD_RE.search(src_lines[node.lineno - 1])
                 if m:
@@ -204,6 +229,138 @@ class _MethodChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _LockOrderVisitor(ast.NodeVisitor):
+    """Per-method acquisition structure for the CC003 lock-order graph.
+
+    Collects (a) direct lock acquisitions with the held-stack at that
+    point, and (b) same-class method calls with the held-stack at the
+    call site; ``_lock_order_edges`` closes (b) over each callee's
+    transitive acquisition set.
+    """
+
+    def __init__(self, locks: set[str]) -> None:
+        self.locks = locks
+        self.held: list[str] = []
+        # lock acquired -> (held locks at acquisition, lineno)
+        self.acquisitions: list[tuple[str, tuple[str, ...], int]] = []
+        # same-class method called -> (held locks at call, lineno)
+        self.calls: list[tuple[str, tuple[str, ...], int]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            attr = _self_attr_base(item.context_expr)
+            if attr is not None and attr in self.locks:
+                self.acquisitions.append(
+                    (attr, tuple(self.held), item.context_expr.lineno))
+                acquired.append(attr)
+                self.held.append(attr)
+        self.generic_visit(node)
+        for a in acquired:
+            self.held.remove(a)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        meth = _self_attr(node.func)
+        if meth is not None:
+            self.calls.append((meth, tuple(self.held), node.lineno))
+        self.generic_visit(node)
+
+
+def _lock_order_edges(info: _ClassInfo
+                      ) -> dict[tuple[str, str], tuple[int, str]]:
+    """Edges ``(held, acquired) -> (lineno, via)`` for one class."""
+    methods = {n.name: n for n in info.cls.body
+               if isinstance(n, ast.FunctionDef)}
+    visits = {}
+    for name, fn in methods.items():
+        v = _LockOrderVisitor(info.locks)
+        for st in fn.body:
+            v.visit(st)
+        visits[name] = v
+    # transitive closure: every lock a method can acquire, including
+    # through same-class calls (cycle-tolerant fixpoint)
+    acquires = {name: {a for a, _, _ in v.acquisitions}
+                for name, v in visits.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, v in visits.items():
+            for callee, _, _ in v.calls:
+                if callee in acquires and not (
+                        acquires[callee] <= acquires[name]):
+                    acquires[name] |= acquires[callee]
+                    changed = True
+    edges: dict[tuple[str, str], tuple[int, str]] = {}
+    for name, v in visits.items():
+        for lock, held, line in v.acquisitions:
+            for h in held:
+                edges.setdefault((h, lock), (line, name))
+        for callee, held, line in v.calls:
+            if not held or callee not in acquires:
+                continue
+            for lock in acquires[callee]:
+                for h in held:
+                    edges.setdefault(
+                        (h, lock), (line, f"{name} -> self.{callee}()"))
+    return edges
+
+
+def _find_cycle(nodes: set[str], edges: set[tuple[str, str]]
+                ) -> list[str] | None:
+    """One directed cycle as [a, b, ..., a], or None."""
+    succ: dict[str, list[str]] = {n: [] for n in nodes}
+    for a, b in edges:
+        succ.setdefault(a, []).append(b)
+    state: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(n: str) -> list[str] | None:
+        state[n] = 1
+        stack.append(n)
+        for m in sorted(succ.get(n, ())):
+            if state.get(m, 0) == 1:
+                return stack[stack.index(m):] + [m]
+            if state.get(m, 0) == 0:
+                cyc = dfs(m)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        state[n] = 2
+        return None
+
+    for n in sorted(nodes):
+        if state.get(n, 0) == 0:
+            cyc = dfs(n)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def _check_lock_order(cls: ast.ClassDef, info: _ClassInfo, relpath: str,
+                      findings: list[Finding]) -> None:
+    edges = _lock_order_edges(info)
+    # self-edge on a non-reentrant lock: one thread deadlocks itself
+    for (a, b), (line, via) in sorted(edges.items()):
+        if a == b and info.lock_kinds.get(a) not in REENTRANT_CTORS:
+            findings.append(Finding(
+                PASS, relpath, line, "CC003",
+                f"{cls.name}: `self.{a}` "
+                f"({info.lock_kinds.get(a, 'Lock')}) re-acquired while "
+                f"already held (via {via}) — non-reentrant, deadlocks "
+                "the acquiring thread"))
+    proper = {(a, b) for (a, b) in edges if a != b}
+    cyc = _find_cycle({n for e in proper for n in e}, proper)
+    if cyc is not None:
+        sites = "; ".join(
+            f"{a}->{b} at line {edges[(a, b)][0]} ({edges[(a, b)][1]})"
+            for a, b in zip(cyc, cyc[1:]))
+        findings.append(Finding(
+            PASS, relpath, edges[(cyc[0], cyc[1])][0], "CC003",
+            f"{cls.name}: lock-order cycle "
+            f"{' -> '.join('self.' + n for n in cyc)} — two threads "
+            f"interleaving these paths deadlock ({sites})"))
+
+
 def _check_class(cls: ast.ClassDef, info: _ClassInfo, relpath: str,
                  findings: list[Finding]) -> None:
     if not info.locks:
@@ -217,6 +374,7 @@ def _check_class(cls: ast.ClassDef, info: _ClassInfo, relpath: str,
         if not isinstance(node, ast.FunctionDef) or node.name == "__init__":
             continue
         _MethodChecker(info, relpath, findings).visit(node)
+    _check_lock_order(cls, info, relpath, findings)
 
 
 def run(root: str, files: list[str] | None = None) -> list[Finding]:
@@ -243,6 +401,8 @@ def run(root: str, files: list[str] | None = None) -> list[Finding]:
                     continue
                 seen.add(base)
                 infos[c.name].locks |= infos[base].locks
+                for attr, k in infos[base].lock_kinds.items():
+                    infos[c.name].lock_kinds.setdefault(attr, k)
                 for attr, g in infos[base].guards.items():
                     infos[c.name].guards.setdefault(attr, g)
                 stack.extend(b.id for b in infos[base].cls.bases

@@ -23,10 +23,17 @@ justify the new numbers in the PR alongside a PERF.md note.
 The lowering path emits ``tracing.annotate`` spans (``lint.hlo.build``
 / ``lint.hlo.lower`` / ``lint.hlo.compile``) so a profiler capture of a
 lint run attributes its cost like any other engine phase.
+
+The ~10 s lower+compile dominates a full lint run, so its result is
+cached in ``analysis/.hlo_budget_cache.json`` (gitignored) keyed by a
+sha256 over the kernel-defining sources and the measurement config:
+back-to-back runs with untouched sources reuse the cached counts, and
+any edit to a hashed file invalidates the cache automatically.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
@@ -35,6 +42,17 @@ from dragonboat_tpu.analysis.common import Finding, rel
 PASS = "hlo-budget"
 
 BUDGET_FILE = "dragonboat_tpu/analysis/hlo_budget.json"
+CACHE_FILE = "dragonboat_tpu/analysis/.hlo_budget_cache.json"
+
+# every source whose edit can change the lowered step graph (or how it
+# is counted) — hashed into the cache key
+CACHE_SOURCES = (
+    "dragonboat_tpu/core/kernel.py",
+    "dragonboat_tpu/core/kstate.py",
+    "dragonboat_tpu/core/params.py",
+    "dragonboat_tpu/bench_loop.py",
+    "dragonboat_tpu/analysis/hlo_budget.py",
+)
 
 # Gated opcodes.  ``gather``/``scatter`` are the TPU-hostile op classes
 # (PERF.md r2/r5); ``while`` bounds control-flow regions (the budget is
@@ -85,6 +103,47 @@ def load_budget(path: str) -> dict:
         return json.load(f)
 
 
+def source_hash(root: str, cfg: dict | None = None) -> str:
+    """sha256 over the kernel-defining sources + measurement config."""
+    h = hashlib.sha256()
+    for src in CACHE_SOURCES:
+        p = os.path.join(root, src)
+        h.update(src.encode())
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(f.read())
+        else:
+            h.update(b"<missing>")
+    h.update(json.dumps(cfg or {}, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def _cache_load(root: str, key: str) -> dict[str, int] | None:
+    path = os.path.join(root, CACHE_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if cache.get("source_hash") != key:
+        return None
+    measured = cache.get("measured")
+    return measured if isinstance(measured, dict) else None
+
+
+def _cache_store(root: str, key: str, measured: dict[str, int]) -> None:
+    path = os.path.join(root, CACHE_FILE)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"source_hash": key, "measured": measured}, f,
+                      indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass  # cache is best-effort; the lint result never depends on it
+
+
 def run(root: str, budget_path: str | None = None,
         measured: dict[str, int] | None = None) -> list[Finding]:
     """Gate ``measured`` (or a fresh measurement) against the budget."""
@@ -97,11 +156,15 @@ def run(root: str, budget_path: str | None = None,
     spec = load_budget(path)
     cfg = spec.get("config", {})
     if measured is None:
-        measured = measure(
-            groups=cfg.get("groups", 64),
-            replicas=cfg.get("replicas", 3),
-            iters=cfg.get("iters", 20),
-            onehot_reads=cfg.get("onehot_reads", True))
+        key = source_hash(root, cfg)
+        measured = _cache_load(root, key)
+        if measured is None:
+            measured = measure(
+                groups=cfg.get("groups", 64),
+                replicas=cfg.get("replicas", 3),
+                iters=cfg.get("iters", 20),
+                onehot_reads=cfg.get("onehot_reads", True))
+            _cache_store(root, key, measured)
     findings = []
     for op in GATED_OPS:
         key = op.replace("-", "_")
@@ -145,4 +208,5 @@ def reseed(root: str, budget_path: str | None = None,
     with open(path, "w", encoding="utf-8") as f:
         json.dump(spec, f, indent=2, sort_keys=True)
         f.write("\n")
+    _cache_store(root, source_hash(root, spec["config"]), measured)
     return spec

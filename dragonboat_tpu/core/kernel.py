@@ -48,6 +48,46 @@ I32 = jnp.int32
 INT_MAX = jnp.iinfo(jnp.int32).max
 MT = pb.MessageType
 
+# Contracts for the kernel-local structs (grammar: core/kstate.py
+# CONTRACTS).  These are PER-SHARD shapes — the kernel body runs under
+# vmap, so there is no [G] axis here; scalars are "[]".
+CONTRACTS = {
+    "Effects": {
+        "need_rep": "[P] bool",
+        "need_hb": "[] bool",
+        "hb_low": "[] i32",
+        "hb_high": "[] i32",
+        "send_vote": "[] i32",
+        "vote_hint": "[] i32",
+        "send_tn": "[P] bool",
+        "rtr_valid": "[RI] bool",
+        "rtr_index": "[RI] i32",
+        "rtr_low": "[RI] i32",
+        "rtr_high": "[RI] i32",
+        "rtr_n": "[] i32",
+        "save_from": "[] i32",
+        "ri_dropped": "[] bool",
+    },
+    "_Pre": {
+        "act": "[] bool",
+        "is_leader": "[] bool",
+        "is_candidate": "[] bool",
+        "is_follower_like": "[] bool",
+        "sender_known": "[] bool",
+        "sender_slot": "[] i32",
+        "noop_reply": "[] bool",
+    },
+    "_Resp": {
+        "r_type": "[] i32",
+        "r_to": "[] i32",
+        "r_term": "[] i32",
+        "r_log_index": "[] i32",
+        "r_reject": "[] bool",
+        "r_hint": "[] i32",
+        "r_hint_high": "[] i32",
+    },
+}
+
 
 def sel(c, a, b):
     return jnp.where(c, a, b)

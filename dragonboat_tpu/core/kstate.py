@@ -19,6 +19,163 @@ import numpy as np
 
 from dragonboat_tpu.core import params as P
 
+# ---------------------------------------------------------------------------
+# Machine-readable field contracts (checked by analysis/contracts.py).
+#
+# Grammar, one string per field:
+#
+#   "[<axes>] <dtype> [tag ...]"
+#
+#   axes    comma-separated symbolic axis names over the kernel geometry:
+#           G  shard axis               (num_shards — vmap strips it)
+#           P  peer slots               (KernelParams.num_peers)
+#           CAP  term-ring capacity     (KernelParams.log_cap, power of two)
+#           K  inbox slots              (KernelParams.inbox_cap)
+#           E  entries per message      (KernelParams.msg_entries)
+#           B  proposal slots           (KernelParams.proposal_cap)
+#           RI ReadIndex book slots     (KernelParams.readindex_cap, 2^n)
+#   dtype   i32 | bool
+#   tags    ring            the leading non-G axis is a power-of-two ring:
+#                           dynamic indexing into it must be masked with
+#                           `& (cap - 1)` (or argmax/arange-bounded to it)
+#           domain=A..B     values live in [params.A, params.B] inclusive
+#           optional        field is None unless the config materializes it
+#
+# The contracts pass (scripts/lint.py --pass contracts) parses this dict
+# from the AST (it must stay a literal), abstractly interprets
+# core/kernel.py against it, and cross-validates it against the
+# eval-shaped structures built by init_state/empty_inbox/empty_input and
+# the step output.  Editing a field here without updating the arrays (or
+# vice versa) is a lint failure, not a comment drifting out of date.
+# ---------------------------------------------------------------------------
+
+CONTRACTS = {
+    "ShardState": {
+        # identity / config
+        "replica_id": "[G] i32",
+        "seed": "[G] i32",
+        "e_timeout": "[G] i32",
+        "h_timeout": "[G] i32",
+        "check_quorum": "[G] bool",
+        "pre_vote": "[G] bool",
+        # core protocol state
+        "role": "[G] i32 domain=FOLLOWER..WITNESS",
+        "term": "[G] i32",
+        "vote": "[G] i32",
+        "leader": "[G] i32",
+        "applied": "[G] i32",
+        "e_tick": "[G] i32",
+        "h_tick": "[G] i32",
+        "rand_timeout": "[G] i32",
+        "rand_counter": "[G] i32",
+        "pending_cc": "[G] bool",
+        "ltt": "[G] i32",
+        "is_ltt": "[G] bool",
+        # peer books
+        "pid": "[G, P] i32",
+        "kind": "[G, P] i32 domain=K_ABSENT..K_WITNESS",
+        "match": "[G, P] i32",
+        "next": "[G, P] i32",
+        "pstate": "[G, P] i32 domain=R_RETRY..R_SNAPSHOT",
+        "active": "[G, P] bool",
+        "psnap": "[G, P] i32",
+        "vresp": "[G, P] bool",
+        "vgrant": "[G, P] bool",
+        # log ring + cursors
+        "lt": "[G, CAP] i32 ring",
+        "lcc": "[G, CAP] bool ring",
+        "snap_index": "[G] i32",
+        "snap_term": "[G] i32",
+        "last": "[G] i32",
+        "committed": "[G] i32",
+        "processed": "[G] i32",
+        "stable": "[G] i32",
+        # ReadIndex circular book
+        "ri_low": "[G, RI] i32 ring",
+        "ri_high": "[G, RI] i32 ring",
+        "ri_index": "[G, RI] i32 ring",
+        "ri_acks": "[G, RI, P] bool ring",
+        "ri_head": "[G] i32",
+        "ri_count": "[G] i32",
+        "needs_host": "[G] bool",
+        "lv": "[G, CAP] i32 ring optional",
+    },
+    "Inbox": {
+        "mtype": "[G, K] i32",
+        "from_": "[G, K] i32",
+        "term": "[G, K] i32",
+        "log_term": "[G, K] i32",
+        "log_index": "[G, K] i32",
+        "commit": "[G, K] i32",
+        "reject": "[G, K] bool",
+        "hint": "[G, K] i32",
+        "hint_high": "[G, K] i32",
+        "n_ent": "[G, K] i32",
+        "ent_term": "[G, K, E] i32",
+        "ent_cc": "[G, K, E] bool",
+        "ent_val": "[G, K, E] i32 optional",
+    },
+    "StepInput": {
+        "prop_valid": "[G, B] bool",
+        "prop_cc": "[G, B] bool",
+        "ri_valid": "[G] bool",
+        "ri_low": "[G] i32",
+        "ri_high": "[G] i32",
+        "transfer_to": "[G] i32",
+        "tick": "[G] bool",
+        "quiesced": "[G] bool",
+        "applied": "[G] i32",
+        "prop_val": "[G, B] i32 optional",
+    },
+    "StepOutput": {
+        "r_type": "[G, K] i32",
+        "r_to": "[G, K] i32",
+        "r_term": "[G, K] i32",
+        "r_log_index": "[G, K] i32",
+        "r_reject": "[G, K] bool",
+        "r_hint": "[G, K] i32",
+        "r_hint_high": "[G, K] i32",
+        "s_rep": "[G, P] bool",
+        "s_prev_index": "[G, P] i32",
+        "s_prev_term": "[G, P] i32",
+        "s_commit": "[G, P] i32",
+        "s_n_ent": "[G, P] i32",
+        "s_ent_term": "[G, P, E] i32",
+        "s_ent_cc": "[G, P, E] bool",
+        "s_ent_val": "[G, P, E] i32 optional",
+        "s_vote": "[G, P] i32",
+        "s_vote_term": "[G, P] i32",
+        "s_vote_lindex": "[G, P] i32",
+        "s_vote_lterm": "[G, P] i32",
+        "s_vote_hint": "[G, P] i32",
+        "s_hb": "[G, P] bool",
+        "s_hb_commit": "[G, P] i32",
+        "s_hb_low": "[G, P] i32",
+        "s_hb_high": "[G, P] i32",
+        "s_timeout_now": "[G, P] bool",
+        "s_need_snapshot": "[G, P] bool",
+        "s_wit_snap": "[G, P] bool",
+        "save_first": "[G] i32",
+        "save_last": "[G] i32",
+        "apply_first": "[G] i32",
+        "apply_last": "[G] i32",
+        "term": "[G] i32",
+        "vote": "[G] i32",
+        "commit": "[G] i32",
+        "rtr_valid": "[G, RI] bool",
+        "rtr_index": "[G, RI] i32",
+        "rtr_low": "[G, RI] i32",
+        "rtr_high": "[G, RI] i32",
+        "ri_dropped": "[G] bool",
+        "prop_accepted": "[G, B] bool",
+        "prop_index": "[G, B] i32",
+        "prop_term": "[G, B] i32",
+        "leader": "[G] i32",
+        "leader_term": "[G] i32",
+        "needs_host": "[G] bool",
+    },
+}
+
 
 class ShardState(NamedTuple):
     """Per-shard raft state; every field has a leading [G] axis (or [G, ...])."""

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis runner: the four lint passes over the repo.
+"""Static-analysis runner: the five lint passes over the repo.
 
 Passes (dragonboat_tpu/analysis/):
 
@@ -8,23 +8,39 @@ Passes (dragonboat_tpu/analysis/):
   hlo-budget      optimized-HLO gather/scatter/while counts of the step
                   kernel vs the checked-in analysis/hlo_budget.json
   concurrency     `# guarded-by:` annotation discipline on shared
-                  mutable state in the threaded modules
+                  mutable state in the threaded modules, plus the CC003
+                  lock-order graph (static deadlock detection)
   determinism     wall clock / unseeded RNG / set-iteration order in
                   the core/ and rsm/ replay paths
+  contracts       machine-checked shape/dtype/domain/ring-mask
+                  contracts over the batched Raft step (abstract
+                  interpretation of core/kernel.py against the
+                  CONTRACTS declarations, plus an eval_shape diff of
+                  declared vs actual structures)
 
 Exit status is non-zero iff any unwaived finding remains.  Waivers live
 in dragonboat_tpu/analysis/waivers.toml; waived findings are still
-printed (with their reasons) so suppressions stay visible.
+printed (with their reasons) so suppressions stay visible.  On a full
+run (no --pass filter) the waivers themselves are linted: an entry
+whose path pattern matches no file (SW001) or that suppressed zero
+findings (SW002) is stale and fails the run.
 
-The hlo-budget pass compiles the bench kernel (~10 s on CPU); skip it
-during tight edit loops with `--pass` selecting the AST passes, or
-refresh its budget after a justified kernel change with
-`--reseed-hlo-budget` (then record why in PERF.md).
+`--format json` emits one finding per line (JSON object with path,
+line, pass, rule, message, waived, reason) so CI can diff findings
+between commits; the default human format is unchanged.
+
+The hlo-budget pass compiles the bench kernel (~10 s on CPU) only when
+a hashed kernel source changed since the cached measurement
+(analysis/.hlo_budget_cache.json); skip it entirely during tight edit
+loops with `--pass` selecting the AST passes, or refresh its budget
+after a justified kernel change with `--reseed-hlo-budget` (then
+record why in PERF.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import os
 import sys
@@ -38,6 +54,7 @@ sys.path.insert(0, ROOT)
 from dragonboat_tpu.analysis import (  # noqa: E402
     common,
     concurrency,
+    contracts,
     determinism,
     hlo_budget,
     tracer_safety,
@@ -48,9 +65,47 @@ PASSES = {
     "concurrency": concurrency.run,
     "determinism": determinism.run,
     "hlo-budget": hlo_budget.run,
+    "contracts": contracts.run,
 }
 
 WAIVERS_FILE = "dragonboat_tpu/analysis/waivers.toml"
+
+
+def _repo_rel_files(root: str) -> list[str]:
+    """Repo-relative paths of all source files (skips ignored dirs)."""
+    skip = {"__pycache__", ".git", ".pytest_cache", ".hypothesis"}
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip]
+        for fn in filenames:
+            out.append(common.rel(root, os.path.join(dirpath, fn)))
+    return out
+
+
+def stale_waiver_findings(waivers: list[common.Waiver],
+                          root: str) -> list[common.Finding]:
+    """SW001/SW002: waivers that outlived the code they excused.
+
+    Only meaningful after a FULL run — a --pass subset legitimately
+    leaves other passes' waivers unexercised — so the caller gates on
+    that.
+    """
+    relpath = common.rel(root, os.path.join(root, WAIVERS_FILE))
+    files = _repo_rel_files(root)
+    findings = []
+    for w in waivers:
+        if not any(fnmatch.fnmatch(p, w.path) for p in files):
+            findings.append(common.Finding(
+                "stale-waiver", relpath, w.line, "SW001",
+                f"waiver path pattern {w.path!r} (pass {w.pass_name}) "
+                "matches no file in the repo — delete the entry"))
+        elif w.hits == 0:
+            findings.append(common.Finding(
+                "stale-waiver", relpath, w.line, "SW002",
+                f"waiver for pass {w.pass_name}, path {w.path!r} "
+                "suppressed zero findings this run — the code it "
+                "excused is gone; delete the entry"))
+    return findings
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -59,7 +114,12 @@ def main(argv: list[str] | None = None) -> int:
                     choices=sorted(PASSES),
                     help="run only this pass (repeatable; default: all)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings on stdout")
+                    help="machine-readable findings blob on stdout "
+                         "(legacy; prefer --format json)")
+    ap.add_argument("--format", choices=("human", "json"), default="human",
+                    help="json = one finding per line "
+                         "(path, line, pass, rule, message, waived, "
+                         "reason); default: human")
     ap.add_argument("--reseed-hlo-budget", action="store_true",
                     help="re-measure the kernel and overwrite "
                          "analysis/hlo_budget.json (justify in PERF.md)")
@@ -78,6 +138,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     selected = args.passes or sorted(PASSES)
+    human = args.format == "human" and not args.json
     unwaived: list[common.Finding] = []
     waived: list[tuple[common.Finding, common.Waiver]] = []
     for name in selected:
@@ -85,14 +146,34 @@ def main(argv: list[str] | None = None) -> int:
         u, w = common.apply_waivers(findings, waivers)
         unwaived += u
         waived += w
-        if not args.json:
+        if human:
             print(f"== {name}: {len(u)} finding(s), {len(w)} waived ==")
             for f in u:
                 print(f"  {f.format()}")
             for f, wv in w:
                 print(f"  [waived: {wv.reason}] {f.format()}")
 
-    if args.json:
+    if args.passes is None:
+        # full run: a waiver that excuses nothing is itself a finding
+        # (not waivable — a waiver cannot excuse its own staleness)
+        stale = stale_waiver_findings(waivers, ROOT)
+        unwaived += stale
+        if human and (stale or waivers):
+            print(f"== stale-waiver: {len(stale)} finding(s) ==")
+            for f in stale:
+                print(f"  {f.format()}")
+
+    def row(f: common.Finding, reason: str | None) -> dict:
+        return {"path": f.path, "line": f.line, "pass": f.pass_name,
+                "rule": f.rule, "message": f.message,
+                "waived": reason is not None, "reason": reason}
+
+    if args.format == "json":
+        for f in unwaived:
+            print(json.dumps(row(f, None), sort_keys=True))
+        for f, wv in waived:
+            print(json.dumps(row(f, wv.reason), sort_keys=True))
+    elif args.json:
         print(json.dumps({
             "findings": [f.__dict__ for f in unwaived],
             "waived": [{"finding": f.__dict__, "reason": wv.reason}

@@ -19,9 +19,11 @@ run() {
     echo "== chunk: $* =="
     PYTHONPATH= "$PY" -m pytest "$@" -q || rc=$?
 }
-# fast pre-test stage: the four static-analysis passes (scripts/lint.py;
-# ~10 s, dominated by one hlo-budget compile at G=64).  After a
-# justified kernel change that shifts the gather/scatter/while counts:
+# fast pre-test stage: the five static-analysis passes (scripts/lint.py;
+# ~2 s when kernel sources are unchanged — the hlo-budget compile result
+# is cached in analysis/.hlo_budget_cache.json keyed by a source hash —
+# and ~12 s after a kernel edit).  After a justified kernel change that
+# shifts the gather/scatter/while counts:
 # `python scripts/lint.py --reseed-hlo-budget`, review the
 # analysis/hlo_budget.json diff, and record why in PERF.md.
 echo "== lint =="

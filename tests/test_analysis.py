@@ -5,6 +5,8 @@ the kernel's actual op counts."""
 
 from __future__ import annotations
 
+import ast
+import importlib.util
 import json
 import os
 import subprocess
@@ -16,12 +18,21 @@ import pytest
 from dragonboat_tpu.analysis import (
     common,
     concurrency,
+    contracts,
     determinism,
     hlo_budget,
     tracer_safety,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "lint_under_test", os.path.join(REPO, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _write(tmp_path, name, src):
@@ -167,6 +178,108 @@ def test_concurrency_sharded_lock_and_inheritance(tmp_path):
     assert "self.log" in findings[0].message
 
 
+# ----------------------------------------------------------- lock order (CC003)
+
+DEADLOCK_FIXTURE = """\
+    import threading
+
+
+    class Deadlocky:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def ab(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def ba(self):
+            with self.b:
+                self._grab_a()         # transitive: ba holds b, takes a
+
+        def _grab_a(self):
+            with self.a:
+                pass
+
+
+    class SelfLock:
+        def __init__(self):
+            self.mu = threading.Lock()
+
+        def outer(self):
+            with self.mu:
+                self.inner()           # re-acquires mu on the same thread
+
+        def inner(self):
+            with self.mu:
+                pass
+
+
+    class Reentrant:
+        def __init__(self):
+            self.mu = threading.RLock()
+
+        def outer(self):
+            with self.mu:
+                self.inner()           # fine: RLock is reentrant
+
+        def inner(self):
+            with self.mu:
+                pass
+
+
+    class FineNested:
+        def __init__(self):
+            self.outer_mu = threading.Lock()
+            self.inner_mu = threading.Lock()
+
+        def f(self):
+            with self.outer_mu:
+                with self.inner_mu:
+                    pass
+
+        def g(self):
+            with self.outer_mu:
+                with self.inner_mu:    # same order everywhere: no cycle
+                    pass
+"""
+
+
+def test_lock_order_cycle_and_self_deadlock(tmp_path):
+    p = _write(tmp_path, "locks.py", DEADLOCK_FIXTURE)
+    findings = concurrency.run(str(tmp_path), files=[p])
+    rules = [f.rule for f in findings]
+    assert rules.count("CC003") == 2 and set(rules) == {"CC003"}
+    msgs = " ".join(f.message for f in findings)
+    # the a->b->a inversion, found through the same-class call graph
+    assert "Deadlocky" in msgs and "lock-order cycle" in msgs
+    assert "_grab_a" in msgs
+    # the non-reentrant re-acquisition
+    assert "SelfLock" in msgs and "re-acquired" in msgs
+    # RLock re-acquisition and consistently-ordered nesting stay clean
+    assert "Reentrant" not in msgs and "FineNested" not in msgs
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    p = _write(tmp_path, "ok.py", """\
+        import threading
+
+
+        class Hub:
+            def __init__(self):
+                self.mu = threading.Lock()
+                self.snap_mu = threading.Lock()
+
+            def send(self):
+                with self.mu:
+                    pass
+                with self.snap_mu:     # sequential, never nested
+                    pass
+    """)
+    assert concurrency.run(str(tmp_path), files=[p]) == []
+
+
 # ------------------------------------------------------------------ determinism
 
 BAD_REPLAY = """\
@@ -307,6 +420,228 @@ def test_hlo_budget_measure_emits_tracing_spans(monkeypatch):
         assert measured[op] <= limit, (op, measured)
 
 
+# -------------------------------------------------------------------- contracts
+
+# A self-contained fixture module: carries its own CONTRACTS literal and
+# domain constants; `St`-annotated params bind the contract class.  Each
+# bad_* function seeds exactly one defect class; ok_* must stay clean.
+CONTRACT_FIXTURE = """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    FOLLOWER = 0
+    WITNESS = 5
+
+    CONTRACTS = {
+        "St": {
+            "role": "[G] i32 domain=FOLLOWER..WITNESS",
+            "match": "[G, P] i32",
+            "lt": "[G, CAP] i32 ring",
+            "flag": "[G] bool",
+        },
+    }
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_broadcast(kp, s: St):
+        k = jnp.arange(kp.inbox_cap)
+        e = jnp.arange(kp.msg_entries)
+        return k + e                   # KC001: [K] + [E] cross-axis
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_upcast(kp, s: St):
+        x = s.match.astype(jnp.float32)
+        return x + s.match             # KC002: f32 + i32
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_cmp(kp, s: St):
+        return s.flag == s.role        # KC003: bool vs i32
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_ring(kp, s: St, idx):
+        return s.lt[idx]               # KC004: unmasked ring index
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def ok_ring(kp, s: St, idx):
+        return s.lt[idx & (kp.log_cap - 1)]   # masked: clean
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_domain(kp, s: St):
+        return s._replace(role=jnp.full_like(s.role, 9))   # KC005: 9 > 5
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def bad_store(kp, s: St):
+        return s._replace(match=s.flag)   # KC006: [G] bool into [G, P] i32
+"""
+
+
+def _contract_findings(tmp_path):
+    p = _write(tmp_path, "fix.py", CONTRACT_FIXTURE)
+    return contracts.run(str(tmp_path), files=[p])
+
+
+def test_contracts_catches_each_defect_class(tmp_path):
+    findings = _contract_findings(tmp_path)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["KC001", "KC002", "KC003", "KC004", "KC005", "KC006"]
+
+
+def test_contracts_broadcast_message_names_both_axes(tmp_path):
+    f = next(f for f in _contract_findings(tmp_path) if f.rule == "KC001")
+    assert "'K'" in f.message and "'E'" in f.message
+
+
+def test_contracts_masked_ring_index_is_clean(tmp_path):
+    findings = _contract_findings(tmp_path)
+    src = textwrap.dedent(CONTRACT_FIXTURE).splitlines()
+    ok_lines = {i + 1 for i, ln in enumerate(src) if "ok_ring" in ln
+                or "masked: clean" in ln}
+    assert not [f for f in findings if f.line in ok_lines]
+
+
+def test_contracts_domain_store_names_bounds(tmp_path):
+    f = next(f for f in _contract_findings(tmp_path) if f.rule == "KC005")
+    assert "FOLLOWER..WITNESS" in f.message and "9" in f.message
+
+
+def test_contract_grammar_parses_and_rejects():
+    fc = common.parse_contract("[G, P] i32 domain=FOLLOWER..WITNESS")
+    assert fc.axes == ("G", "P") and fc.dtype == "i32"
+    assert fc.domain == ("FOLLOWER", "WITNESS") and not fc.ring
+    fc = common.parse_contract("[G, CAP] bool ring optional")
+    assert fc.ring and fc.optional and fc.domain is None
+    assert common.parse_contract("[] i32").axes == ()
+    with pytest.raises(common.ContractError, match="dtype"):
+        common.parse_contract("[G] i16")
+    with pytest.raises(common.ContractError, match="tag"):
+        common.parse_contract("[G] i32 wat")
+    with pytest.raises(common.ContractError, match="domain"):
+        common.parse_contract("[G] i32 domain=LOW")
+
+
+def test_broadcast_axes_lattice():
+    assert common.broadcast_axes(("G", "P"), ("P",)) == (("G", "P"), None)
+    assert common.broadcast_axes(("G", "1"), ("G", "P")) == (("G", "P"), None)
+    axes, conflict = common.broadcast_axes(("K",), ("E",))
+    assert conflict is not None and "'K'" in conflict
+    # unknown unifies optimistically
+    assert common.broadcast_axes(None, ("G",)) == (("G",), None)
+    assert common.broadcast_axes(("?",), ("G",)) == (("G",), None)
+
+
+def test_contracts_pass_clean_on_repo_kernel():
+    """The acceptance gate: zero findings on the checked-in kernel,
+    including the eval_shape declared-vs-actual diff."""
+    assert contracts.run(REPO) == []
+
+
+@pytest.mark.parametrize("G,P,CAP", [(1, 3, 32), (5, 5, 64), (2, 1, 16),
+                                     (7, 4, 128)])
+def test_contracts_runtime_roundtrip(G, P, CAP):
+    """Declared contracts match the eval-shaped structures across
+    geometries (all-distinct satellite axes keep axis names honest)."""
+    from dragonboat_tpu.core.params import KernelParams
+
+    kp = KernelParams(num_peers=P, log_cap=CAP, inbox_cap=4, msg_entries=5,
+                      proposal_cap=6, readindex_cap=8)
+    assert contracts.runtime_check(kp=kp, num_shards=G, root=REPO) == []
+
+
+def test_contracts_runtime_flags_declared_vs_actual_mismatch(tmp_path):
+    """Tampering one declared shape must surface as KC007 against the
+    real init_state output."""
+    real = os.path.join(REPO, "dragonboat_tpu/core/kstate.py")
+    with open(real, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    seg = next(ast.get_source_segment(src, n) for n in tree.body
+               if isinstance(n, ast.Assign)
+               and getattr(n.targets[0], "id", None) == "CONTRACTS")
+    good = '"role": "[G] i32 domain=FOLLOWER..WITNESS"'
+    assert good in seg
+    tampered = seg.replace(good, '"role": "[G, P] i32"')
+    d = tmp_path / "dragonboat_tpu" / "core"
+    d.mkdir(parents=True)
+    (d / "kstate.py").write_text(tampered + "\n")
+    findings = contracts.runtime_check(root=str(tmp_path), eval_step=False)
+    role = [f for f in findings if "ShardState.role" in f.message]
+    assert role and all(f.rule == "KC007" for f in role)
+    assert "['G', 'P']" in role[0].message
+
+
+# ---------------------------------------------------------------- stale waivers
+
+
+def test_stale_waiver_pattern_matching_no_file(tmp_path):
+    lint = _load_lint_module()
+    (tmp_path / "real.py").write_text("x = 1\n")
+    w = common.Waiver(pass_name="contracts", path="no/such/*.py",
+                      reason="outlived", line=7)
+    findings = lint.stale_waiver_findings([w], str(tmp_path))
+    assert [f.rule for f in findings] == ["SW001"]
+    assert findings[0].line == 7
+
+
+def test_stale_waiver_with_zero_hits(tmp_path):
+    lint = _load_lint_module()
+    (tmp_path / "real.py").write_text("x = 1\n")
+    w = common.Waiver(pass_name="contracts", path="real.py",
+                      reason="outlived", line=3)
+    assert [f.rule for f in lint.stale_waiver_findings([w], str(tmp_path))
+            ] == ["SW002"]
+    w.hits = 1                      # exercised waiver: not stale
+    assert lint.stale_waiver_findings([w], str(tmp_path)) == []
+
+
+def test_stale_waiver_fails_full_lint_run(tmp_path, monkeypatch, capsys):
+    lint = _load_lint_module()
+    monkeypatch.setattr(lint, "PASSES", {"noop": lambda root: []})
+    wpath = tmp_path / "waivers.toml"
+    wpath.write_text(textwrap.dedent("""\
+        [[waiver]]
+        pass_name = "noop"
+        path = "no/such/file.py"
+        reason = "stale on purpose"
+    """))
+    monkeypatch.setattr(lint, "ROOT", str(tmp_path))
+    monkeypatch.setattr(lint, "WAIVERS_FILE", "waivers.toml")
+    assert lint.main([]) == 1
+    assert "SW001" in capsys.readouterr().out
+    # a --pass subset legitimately skips staleness (other passes unrun)
+    assert lint.main(["--pass", "noop"]) == 0
+
+
+# ------------------------------------------------------------------ json format
+
+
+def test_lint_format_json_one_finding_per_line(monkeypatch, capsys):
+    lint = _load_lint_module()
+    hits = [common.Finding("fake", "a.py", 3, "XX001", "boom"),
+            common.Finding("fake", "b.py", 9, "XX002", "waive me")]
+    monkeypatch.setattr(lint, "PASSES", {"fake": lambda root: list(hits)})
+    monkeypatch.setattr(
+        lint.common, "load_waivers",
+        lambda path: [common.Waiver(pass_name="fake", path="b.py",
+                                    reason="fixture")])
+    rc = lint.main(["--pass", "fake", "--format", "json"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    rows = [json.loads(ln) for ln in lines]
+    assert rc == 1 and len(rows) == 2
+    by_path = {r["path"]: r for r in rows}
+    assert by_path["a.py"] == {"path": "a.py", "line": 3, "pass": "fake",
+                               "rule": "XX001", "message": "boom",
+                               "waived": False, "reason": None}
+    assert by_path["b.py"]["waived"] and by_path["b.py"]["reason"] == "fixture"
+
+
 # ----------------------------------------------------------------------- runner
 
 
@@ -316,7 +651,7 @@ def test_lint_runner_ast_passes_clean_on_repo():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
          "--pass", "tracer-safety", "--pass", "concurrency",
-         "--pass", "determinism"],
+         "--pass", "determinism", "--pass", "contracts"],
         capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
