@@ -50,6 +50,7 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/engine/apply_pool.py",
     "dragonboat_tpu/request.py",
     "dragonboat_tpu/events.py",
+    "dragonboat_tpu/chaos/crashfs.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

@@ -31,6 +31,13 @@ PASS = "determinism"
 DEFAULT_GLOBS = (
     "dragonboat_tpu/core/*.py",
     "dragonboat_tpu/rsm/*.py",
+    # the replay-contract side of the chaos harness: plan generation,
+    # fault cartridge, oracle.  runner.py is deliberately NOT listed —
+    # it waits on real elections/recovery, so wall-clock use is its job;
+    # the deterministic trace contract lives in these three.
+    "dragonboat_tpu/chaos/faultplan.py",
+    "dragonboat_tpu/chaos/crashfs.py",
+    "dragonboat_tpu/chaos/oracle.py",
 )
 
 WALL_CLOCK = {

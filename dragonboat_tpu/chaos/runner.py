@@ -1,0 +1,367 @@
+"""Schedule runner — executes a FaultPlan against a live MemFS cluster.
+
+One run builds ``n_replicas`` durable NodeHosts, each over its OWN
+MemFS (so per-host power loss is ``that_fs.crash()``) wrapped in a
+:class:`CrashPointFS` (so storage faults arm per host), all joined by
+the chan transport.  The plan's steps interleave with a write workload;
+every executed event is recorded, and the recorded trace is canonical
+JSON — running the same seed twice yields byte-identical traces
+(tests/test_chaos_schedules.py asserts exactly that).
+
+This module intentionally uses the wall clock: it WAITS on real raft
+progress (elections, replication, restart recovery), so it is excluded
+from the determinism lint's replay-path globs.  The deterministic
+contract lives in faultplan/crashfs/oracle, which are covered.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import zlib
+from dataclasses import dataclass, field
+from random import Random
+
+from dragonboat_tpu.chaos.crashfs import CrashPointFS
+from dragonboat_tpu.chaos.faultplan import FaultPlan, canonical_json
+from dragonboat_tpu.chaos.oracle import OracleReport, check_convergence
+from dragonboat_tpu.config import (
+    Config,
+    ExpertConfig,
+    LogDBConfig,
+    NodeHostConfig,
+)
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.vfs import MemFS
+
+
+class ChaosKV(IStateMachine):
+    """Workload SM: kv store plus an append-only journal of every
+    applied command — the committed-prefix observable the oracle
+    compares across replicas (monkey-test HashKV with history)."""
+
+    def __init__(self, shard_id, replica_id):
+        self.kv = {}
+        self.journal: list[bytes] = []
+
+    def update(self, entry):
+        cmd = bytes(entry.cmd)
+        self.journal.append(cmd)
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        return Result(value=len(self.journal))
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        blob = b"\x00".join(self.journal)
+        w.write(struct.pack("<I", len(blob)))
+        w.write(blob)
+
+    def recover_from_snapshot(self, r, files, done):
+        (n,) = struct.unpack("<I", r.read(4))
+        blob = r.read(n)
+        self.journal = blob.split(b"\x00") if blob else []
+        self.kv = {}
+        for cmd in self.journal:
+            k, v = cmd.decode().split("=", 1)
+            self.kv[k] = v
+
+    def get_hash(self) -> int:
+        return zlib.crc32(b"\x00".join(self.journal))
+
+
+def _counter_pred(every: int):
+    """Deterministic per-message predicate: True on every Nth call."""
+    state = {"n": 0}
+
+    def pred(_m) -> bool:
+        state["n"] += 1
+        return state["n"] % every == 0
+    return pred
+
+
+@dataclass
+class ScheduleResult:
+    seed: int
+    trace_json: str
+    report: OracleReport
+    acked_count: int
+    plan_json: str
+
+
+@dataclass
+class _Cluster:
+    seed: int
+    n: int
+    hosts: dict = field(default_factory=dict)      # rid -> NodeHost
+    mems: dict = field(default_factory=dict)       # rid -> MemFS
+    fss: dict = field(default_factory=dict)        # rid -> CrashPointFS
+    addrs: dict = field(default_factory=dict)
+    cfgs: dict = field(default_factory=dict)       # rid -> Config
+    epochs: dict = field(default_factory=dict)     # rid -> restart epoch
+
+    SHARD = 1
+
+    def start(self) -> None:
+        self.addrs = {rid: f"cs{self.seed}-{rid}"
+                      for rid in range(1, self.n + 1)}
+        for rid in sorted(self.addrs):
+            self.mems[rid] = MemFS()
+            self.epochs[rid] = 0
+            self._spawn(rid)
+
+    def _nhconfig(self, rid: int) -> NodeHostConfig:
+        return NodeHostConfig(
+            raft_address=self.addrs[rid], rtt_millisecond=5,
+            node_host_dir="/data",
+            expert=ExpertConfig(
+                fs=self.fss[rid],
+                logdb=LogDBConfig(shards=1,
+                                  recovery_mode="quarantine")))
+
+    def _spawn(self, rid: int) -> None:
+        """Fresh NodeHost (+ fresh CrashPointFS) over rid's MemFS."""
+        self.fss[rid] = CrashPointFS(self.mems[rid])
+        nh = NodeHost(self._nhconfig(rid))
+        cfg = Config(shard_id=self.SHARD, replica_id=rid, election_rtt=10,
+                     heartbeat_rtt=1, snapshot_entries=0,
+                     compaction_overhead=5)
+        self.cfgs[rid] = cfg
+        nh.start_replica(dict(self.addrs), False, ChaosKV, cfg)
+        self.hosts[rid] = nh
+
+    # -- liveness --------------------------------------------------------
+
+    def live(self, rid: int) -> bool:
+        nh = self.hosts[rid]
+        return nh.fatal_error is None and not nh._stopped
+
+    def live_rids(self) -> list:
+        return [rid for rid in sorted(self.hosts) if self.live(rid)]
+
+    def reset_breakers(self) -> None:
+        """Post-heal: close every breaker so recovery is not paced by
+        leftover backoff cooldowns (production relies on the backoff
+        probes; the harness heals instantly to keep schedules fast)."""
+        for rid in self.live_rids():
+            hub = self.hosts[rid].hub
+            for addr in sorted(self.addrs.values()):
+                hub.breaker(addr).succeed()
+
+    # -- event execution -------------------------------------------------
+
+    def execute(self, ev) -> dict:
+        fn = getattr(self, "_ev_" + ev.kind)
+        return fn(ev.target, dict(ev.params))
+
+    def _ev_drop(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].transport.drop_predicate = _counter_pred(p["every"])
+        return {"applied": self.live(rid)}
+
+    def _ev_delay(self, rid: int, p: dict) -> dict:
+        secs = p["seconds"]
+        self.hosts[rid].transport.delay_func = lambda m: secs
+        return {"applied": self.live(rid)}
+
+    def _ev_duplicate(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].transport.duplicate_predicate = _counter_pred(
+            p["every"])
+        return {"applied": self.live(rid)}
+
+    def _ev_reorder(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].transport.reorder_rng = Random(p["seed"])
+        return {"applied": self.live(rid)}
+
+    def _ev_heal_transport(self, rid: int, p: dict) -> dict:
+        t = self.hosts[rid].transport
+        t.drop_predicate = None
+        t.delay_func = None
+        t.duplicate_predicate = None
+        t.reorder_rng = None
+        return {"applied": True}
+
+    def _ev_partition(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].partition_node()
+        return {"applied": True}
+
+    def _ev_restore_partition(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].restore_partitioned_node()
+        self.reset_breakers()
+        return {"applied": True}
+
+    def _ev_breaker_trip(self, rid: int, p: dict) -> dict:
+        target_addr = self.addrs[rid]
+        for other in self.live_rids():
+            if other != rid:
+                self.hosts[other].hub.trip_breaker(
+                    target_addr, count=p["count"])
+        return {"applied": True}
+
+    def _ev_heal_breaker(self, rid: int, p: dict) -> dict:
+        self.reset_breakers()
+        return {"applied": True}
+
+    def _ev_crash_write(self, rid: int, p: dict) -> dict:
+        self.fss[rid].arm(p["after_ops"], torn=p["torn"])
+        tripped = self._pump_until(
+            lambda: self.hosts[rid].fatal_error is not None, timeout=15.0)
+        return {"tripped": tripped}
+
+    def _ev_restart_inplace(self, rid: int, p: dict) -> dict:
+        self.fss[rid].heal()
+        self.hosts[rid].restart()
+        self.epochs[rid] += 1
+        self.reset_breakers()
+        return {"restarted": True}
+
+    def _ev_kill(self, rid: int, p: dict) -> dict:
+        self.hosts[rid].simulate_kill()
+        # the process is gone: unsynced bytes vanish, its flocks release
+        self.mems[rid].crash()
+        return {"killed": True}
+
+    def _ev_restart_process(self, rid: int, p: dict) -> dict:
+        self._spawn(rid)
+        self.epochs[rid] += 1
+        self.reset_breakers()
+        return {"restarted": True}
+
+    # -- workload --------------------------------------------------------
+
+    def propose(self, cmd: bytes, timeout: float = 8.0) -> bool:
+        """Propose through any live host (host routing forwards to the
+        leader); True once acked.  Duplicate commits from retried
+        timeouts are fine — the oracle compares journals for equality,
+        and a duplicate lands identically on every replica."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for rid in self.live_rids():
+                nh = self.hosts[rid]
+                if nh._partitioned:
+                    continue
+                try:
+                    nh.sync_propose(nh.get_noop_session(self.SHARD), cmd,
+                                    timeout_s=1.5)
+                    return True
+                except Exception:
+                    continue
+            time.sleep(0.02)
+        return False
+
+    def _pump_until(self, cond, timeout: float) -> bool:
+        """Feed proposals until ``cond`` holds (durability traffic is
+        what walks an armed CrashPointFS to its trip)."""
+        deadline = time.time() + timeout
+        i = 0
+        while time.time() < deadline:
+            if cond():
+                return True
+            self.propose(f"pump{i}=x".encode(), timeout=1.0)
+            i += 1
+        return cond()
+
+    # -- observations ----------------------------------------------------
+
+    def sample(self, applied_samples: dict) -> None:
+        for rid in self.live_rids():
+            nh = self.hosts[rid]
+            if nh._partitioned:
+                continue
+            try:
+                applied = nh._node(self.SHARD).sm.get_last_applied()
+            except Exception:
+                continue
+            applied_samples.setdefault(rid, []).append(
+                (self.epochs[rid], applied))
+
+    def journals(self) -> dict:
+        out = {}
+        for rid in self.live_rids():
+            try:
+                out[rid] = list(
+                    self.hosts[rid]._node(self.SHARD).sm.sm.journal)
+            except Exception:
+                continue
+        return out
+
+    def hashes(self, kind: str) -> dict:
+        fn = {"sm": "get_sm_hash", "session": "get_session_hash",
+              "membership": "get_membership_hash"}[kind]
+        out = {}
+        for rid in self.live_rids():
+            try:
+                out[rid] = getattr(self.hosts[rid], fn)(self.SHARD)
+            except Exception:
+                continue
+        return out
+
+    def close(self) -> None:
+        for rid in sorted(self.hosts):
+            nh = self.hosts[rid]
+            try:
+                if nh.fatal_error is not None and nh._stopped:
+                    continue        # killed/crashed and never restarted
+                nh.close()
+            except Exception:
+                pass
+
+
+def run_schedule(seed: int, plan: FaultPlan | None = None,
+                 n_replicas: int = 3, steps: int = 6,
+                 proposals_per_step: int = 4,
+                 converge_timeout: float = 30.0) -> ScheduleResult:
+    """Execute one composed fault schedule; returns the recorded trace
+    (canonical JSON) and the oracle report.  Pass ``plan`` to replay a
+    recorded trace (``FaultPlan.from_json``) instead of generating."""
+    if plan is None:
+        plan = FaultPlan.generate(seed, n_replicas=n_replicas, steps=steps)
+    cluster = _Cluster(seed=seed, n=plan.n_replicas)
+    executed: list = []
+    acked: list = []
+    applied_samples: dict = {}
+    report = OracleReport()
+    try:
+        cluster.start()
+        # settle: a leader before the first fault
+        cluster.propose(b"genesis=1", timeout=10.0) or report.fail(
+            "no initial commit — cluster never settled")
+        for step in range(plan.steps + 1):
+            for ev in plan.events_at(step):
+                outcome = cluster.execute(ev)
+                executed.append({**ev.as_dict(), "outcome": outcome})
+                if outcome.get("tripped") is False:
+                    report.fail(f"crash point on replica {ev.target} "
+                                "never tripped")
+            if step < plan.steps:
+                for i in range(proposals_per_step):
+                    cmd = f"s{step}i{i}=v{seed}".encode()
+                    if cluster.propose(cmd):
+                        acked.append(cmd)
+                cluster.sample(applied_samples)
+        # every replica is healed now; wait for full convergence
+        deadline = time.time() + converge_timeout
+        converged = False
+        while time.time() < deadline and not converged:
+            cluster.sample(applied_samples)
+            js = cluster.journals()
+            if len(js) == cluster.n:
+                vals = list(js.values())
+                have = set(vals[0])
+                converged = all(v == vals[0] for v in vals[1:]) and all(
+                    c in have for c in acked)
+            if not converged:
+                time.sleep(0.1)
+        if not converged:
+            report.fail("cluster did not converge after final heal")
+        report.merge(check_convergence(
+            acked, cluster.journals(), applied_samples,
+            cluster.hashes("sm"), cluster.hashes("session"),
+            cluster.hashes("membership")))
+    finally:
+        cluster.close()
+    return ScheduleResult(
+        seed=seed, trace_json=canonical_json(executed), report=report,
+        acked_count=len(acked), plan_json=plan.to_json())

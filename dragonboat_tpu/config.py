@@ -96,10 +96,18 @@ class LogDBConfig:
     ``engine`` picks the per-partition storage engine — ``"tan"`` (the
     purpose-built log-file engine, the default) or ``"kv"`` (the
     sorted-KV LSM engine, the analog of the reference's Pebble logdb);
-    the choice is pinned into the on-disk layout on first open."""
+    the choice is pinned into the on-disk layout on first open.
+
+    ``recovery_mode`` governs what a tan partition does with a bad
+    checksum in a NON-tail log file on open: ``"strict"`` refuses to
+    open (historical behavior), ``"quarantine"`` truncates at the
+    corruption, clamps the persisted commit to the entries still
+    contiguously present, and lets raft re-replicate the rest from the
+    quorum (snapshot fallback when the entries were compacted away)."""
 
     shards: int = 16
     engine: str = "tan"
+    recovery_mode: str = "strict"
 
 
 @dataclass(frozen=True)

@@ -55,7 +55,8 @@ class ShardedLogDB(ILogDB):
 
     def __init__(self, root_dir: str, num_shards: int = 16,
                  max_file_size: int = 64 << 20, fs=None,
-                 engine: str = "tan") -> None:
+                 engine: str = "tan",
+                 recovery_mode: str = "strict") -> None:
         from dragonboat_tpu.vfs import default_fs
 
         if num_shards < 1:
@@ -66,6 +67,7 @@ class ShardedLogDB(ILogDB):
         self.root = root_dir
         self.num_shards = num_shards
         self.engine = engine
+        self.recovery_mode = recovery_mode
         self.fs.makedirs(self.root)
         # refuse a legacy layout under a non-tan engine BEFORE the marker
         # is written: a persisted "kv" marker over tan data would make the
@@ -82,12 +84,18 @@ class ShardedLogDB(ILogDB):
                 from dragonboat_tpu.logdb.kvdb import KVLogDB
 
                 return KVLogDB(path, fs=self.fs)
-            return TanLogDB(path, max_file_size=max_file_size, fs=self.fs)
+            return TanLogDB(path, max_file_size=max_file_size, fs=self.fs,
+                            recovery_mode=recovery_mode)
 
         self._parts = [
             make_part(os.path.join(self.root, f"part-{i:02d}"))
             for i in range(num_shards)
         ]
+        # corruption sites quarantined by the tan partitions on open
+        # (always empty under engine="kv" or recovery_mode="strict")
+        self.quarantined: list[str] = [
+            q for p in self._parts
+            for q in getattr(p, "quarantined", ())]
         # flush pool for batches that span partitions (device engine):
         # sized to the partition count, NOT cpu_count — these tasks block
         # in fsync, they do not compute
@@ -277,14 +285,17 @@ class ShardedLogDBFactory:
 
     def __init__(self, root_dir: str, num_shards: int = 16,
                  max_file_size: int = 64 << 20, fs=None,
-                 engine: str = "tan") -> None:
+                 engine: str = "tan",
+                 recovery_mode: str = "strict") -> None:
         self.root_dir = root_dir
         self.num_shards = num_shards
         self.max_file_size = max_file_size
         self.fs = fs
         self.engine = engine
+        self.recovery_mode = recovery_mode
 
     def create(self) -> ShardedLogDB:
         return ShardedLogDB(self.root_dir, self.num_shards,
                             self.max_file_size, fs=self.fs,
-                            engine=self.engine)
+                            engine=self.engine,
+                            recovery_mode=self.recovery_mode)

@@ -46,8 +46,13 @@ R_META = 6         # re-homed node metadata (pre file-deletion checkpoint)
 _KEY = struct.Struct("<BQQ")          # rectype, shard_id, replica_id
 
 
-class CorruptLogError(Exception):
-    """A non-tail record failed its checksum — the log is damaged."""
+class CorruptLogError(OSError):
+    """A record failed its checksum — the log is damaged.
+
+    An OSError subclass so a corrupt read hit at RUNTIME (not open)
+    routes through the engine workers' storage-failure path into the
+    NodeHost controlled crash, instead of being retried forever by the
+    generic exception guard."""
 
 
 class _RangeIndex:
@@ -173,12 +178,22 @@ class TanLogDB(ILogDB):
     """File-backed ILogDB; one instance owns one directory."""
 
     def __init__(self, root_dir: str, max_file_size: int = 64 << 20,
-                 fs=None) -> None:
+                 fs=None, recovery_mode: str = "strict") -> None:
         from dragonboat_tpu.vfs import default_fs
 
+        if recovery_mode not in ("strict", "quarantine"):
+            raise ValueError(f"unknown recovery_mode {recovery_mode!r}")
         self.fs = fs if fs is not None else default_fs()
         self.root = root_dir
         self.max_file_size = max_file_size
+        # "strict": a bad checksum in a non-tail file refuses to open
+        # (the historical behavior).  "quarantine": truncate the file at
+        # the corruption, record it in ``quarantined``, and clamp each
+        # node's persisted commit to what is still contiguously present —
+        # the node then reopens behind the shard and the leader re-
+        # replicates (or snapshots) it back, instead of a dead replica.
+        self.recovery_mode = recovery_mode
+        self.quarantined: list[str] = []
         self.fs.makedirs(self.root)
         self._mu = threading.RLock()
         self._nodes: dict[tuple[int, int], _Node] = {}
@@ -251,6 +266,24 @@ class TanLogDB(ILogDB):
         if files:
             # resume appending to the newest file
             self._open_active(files[-1])
+        if self.quarantined:
+            self._clamp_after_quarantine()
+
+    def _clamp_after_quarantine(self) -> None:
+        """Quarantine dropped records, so a node's persisted commit may
+        point past the entries still on disk — the in-core log asserts
+        ``commit <= last_index`` on load.  Clamp each commit to the
+        contiguous range actually present; raft re-commits the rest once
+        the leader re-replicates (committed-entry durability lives on
+        the quorum, not this replica)."""
+        for key, n in self._nodes.items():
+            if n.removed:
+                continue
+            avail = n.snapshot.index + n.entries.contiguous_count(
+                n.snapshot.index + 1)
+            if n.state.commit > avail:
+                n.state = pb.State(term=n.state.term, vote=n.state.vote,
+                                   commit=avail)
 
     def _replay_file(self, fileno: int, truncate_tail: bool) -> None:
         """Single-pass scan + validate of a whole log file — the frame walk
@@ -268,6 +301,11 @@ class TanLogDB(ILogDB):
             if truncate_tail:
                 with self.fs.open(path, "r+b") as tf:
                     tf.truncate(scan_end)
+                return
+            if self.recovery_mode == "quarantine":
+                with self.fs.open(path, "r+b") as tf:
+                    tf.truncate(scan_end)
+                self.quarantined.append(f"{path}@{scan_end}")
                 return
             raise CorruptLogError(
                 f"{path}@{scan_end}: bad record in non-tail log file")
@@ -535,9 +573,12 @@ class TanLogDB(ILogDB):
 class TanLogDBFactory:
     """config.LogDBFactory equivalent for NodeHostConfig."""
 
-    def __init__(self, root_dir: str, max_file_size: int = 64 << 20) -> None:
+    def __init__(self, root_dir: str, max_file_size: int = 64 << 20,
+                 recovery_mode: str = "strict") -> None:
         self.root_dir = root_dir
         self.max_file_size = max_file_size
+        self.recovery_mode = recovery_mode
 
     def create(self) -> TanLogDB:
-        return TanLogDB(self.root_dir, self.max_file_size)
+        return TanLogDB(self.root_dir, self.max_file_size,
+                        recovery_mode=self.recovery_mode)

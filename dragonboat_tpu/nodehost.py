@@ -118,7 +118,8 @@ class NodeHost:
                     self.logdb = ShardedLogDB(
                         self.env.logdb_dir,
                         num_shards=nhconfig.expert.logdb.shards,
-                        fs=self.fs, engine=engine)
+                        fs=self.fs, engine=engine,
+                        recovery_mode=nhconfig.expert.logdb.recovery_mode)
                 self.id = self.env.node_host_id()
             except Exception:
                 db = getattr(self, "logdb", None)
@@ -152,6 +153,12 @@ class NodeHost:
         )
         self.mu = threading.RLock()
         self.nodes: dict[int, Node] = {}
+        # a directly-injected ILogDB object cannot be reopened by
+        # restart() (no recipe to rebuild it); factories can
+        self._injected_logdb = logdb is not None
+        # start_replica arguments per shard, so restart() can rebuild
+        # every replica from disk after a controlled crash
+        self._replica_specs: dict[int, tuple] = {}        # guarded-by: mu
         # ONE logical clock for every node's request books — advanced
         # once per tick round by the ticker (absolute deadline stamps;
         # the per-lane per-book advance walk was the 100k election
@@ -215,34 +222,40 @@ class NodeHost:
         self._apply_pool = ApplyPool(
             num_workers=max(1, min(nhconfig.expert.engine.apply_shards, 16)),
             on_work_done=self._work.set, name=f"apply-{self.id[:8]}")
+        self._auto_run = auto_run
         if auto_run:
-            # worker threads jit-compile the step kernel on their first
-            # engine iteration; XLA's compile recursion on large graphs
-            # overflows the default pthread stack (observed as a segfault
-            # inside backend_compile in exec-0 threads, 2026-07-31), so
-            # engine threads get a deep stack.  stack_size() is process-
-            # global for threads created while set — the class lock keeps
-            # concurrent NodeHost constructions from racing the window.
-            with NodeHost._stack_size_mu:
-                prev_stack = threading.stack_size()
-                try:
-                    threading.stack_size(64 << 20)
-                except (ValueError, RuntimeError):
-                    prev_stack = None
-                try:
-                    self._engine_thread = threading.Thread(
-                        target=self._engine_main,
-                        name=f"engine-{self.id[:12]}", daemon=True)
-                    self._engine_thread.start()
-                    for w in range(self._num_workers):
-                        t = threading.Thread(
-                            target=self._worker_main, args=(w,),
-                            name=f"exec-{w}-{self.id[:8]}", daemon=True)
-                        t.start()
-                        self._workers.append(t)
-                finally:
-                    if prev_stack is not None:
-                        threading.stack_size(prev_stack)
+            self._start_engine_threads()
+
+    def _start_engine_threads(self) -> None:
+        """Spawn the engine ticker + step workers (also from restart()).
+
+        Worker threads jit-compile the step kernel on their first
+        engine iteration; XLA's compile recursion on large graphs
+        overflows the default pthread stack (observed as a segfault
+        inside backend_compile in exec-0 threads, 2026-07-31), so
+        engine threads get a deep stack.  stack_size() is process-
+        global for threads created while set — the class lock keeps
+        concurrent NodeHost constructions from racing the window."""
+        with NodeHost._stack_size_mu:
+            prev_stack = threading.stack_size()
+            try:
+                threading.stack_size(64 << 20)
+            except (ValueError, RuntimeError):
+                prev_stack = None
+            try:
+                self._engine_thread = threading.Thread(
+                    target=self._engine_main,
+                    name=f"engine-{self.id[:12]}", daemon=True)
+                self._engine_thread.start()
+                for w in range(self._num_workers):
+                    t = threading.Thread(
+                        target=self._worker_main, args=(w,),
+                        name=f"exec-{w}-{self.id[:8]}", daemon=True)
+                    t.start()
+                    self._workers.append(t)
+            finally:
+                if prev_stack is not None:
+                    threading.stack_size(prev_stack)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -290,6 +303,123 @@ class NodeHost:
             close_registry()
         if self.env is not None:
             self.env.close()
+
+    def restart(self, timeout_s: float = 5.0) -> None:
+        """Recover IN PLACE from a controlled storage crash: reopen the
+        log engine from the data dir and rebuild every replica that was
+        running when ``_on_fatal`` halted the host.
+
+        The reference's ErrorFS crash arming panics the process and the
+        operator restarts it (nodehost.go:361-367) — a library host
+        cannot exec itself, so this is that operator restart: same
+        process, same Env lock, fresh LogDB + Nodes from what reached
+        stable storage.  Acks sent after the failed fsync were never
+        acted on (the host halted immediately), so replaying the disk
+        state is exactly the durable prefix."""
+        with self.mu:
+            if not self._stopped:
+                raise RequestError("restart requires a stopped host")
+            if self._injected_logdb:
+                raise RequestError(
+                    "cannot restart: the injected LogDB object has no "
+                    "reopen recipe (use a logdb_factory)")
+            if self.config.logdb_factory is None and self.env is None:
+                raise RequestError(
+                    "cannot restart: no durable data dir to recover from")
+            nodes = list(self.nodes.values())
+            self.nodes.clear()
+            specs = sorted(self._replica_specs.items())
+            self._replica_specs.clear()
+        if self.mesh_engine is not None:
+            from dragonboat_tpu.engine.mesh_engine import detach_mesh_engine
+
+            for n in nodes:
+                if getattr(n, "engine", None) is self.mesh_engine:
+                    self.mesh_engine.remove_replica(n)
+            detach_mesh_engine(self.mesh_engine)
+            self.mesh_engine = None
+        self.kernel_engine = None
+        self._work.set()
+        for ev in self._worker_events:
+            ev.set()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=timeout_s)
+        for t in self._workers:
+            t.join(timeout=timeout_s)
+        self._workers = []
+        self._engine_thread = None
+        for n in nodes:
+            self._apply_pool.flush(n.shard_id, timeout=timeout_s)
+            n.destroy()
+            self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
+        try:
+            self.logdb.close()
+        except OSError:
+            # the engine that failed its fsync may fail the closing one
+            # too; the reopen below rereads whatever IS durable
+            _LOG.exception("logdb close failed during restart")
+        if self.config.logdb_factory is not None:
+            self.logdb = self.config.logdb_factory.create()
+        else:
+            self.logdb = ShardedLogDB(
+                self.env.logdb_dir,
+                num_shards=self.config.expert.logdb.shards,
+                fs=self.fs, engine=self.config.expert.logdb.engine,
+                recovery_mode=self.config.expert.logdb.recovery_mode)
+        with self.mu:
+            self.fatal_error = None
+            self._stopped = False
+        if self._auto_run:
+            self._start_engine_threads()
+        for _sid, (members, join, create_sm, cfg) in specs:
+            self.start_replica(members, join, create_sm, cfg)
+        _LOG.info("NodeHost %s restarted with %d replica(s)",
+                  self.id, len(specs))
+
+    def simulate_kill(self) -> None:
+        """Chaos surface: die like a killed process — stop every thread
+        and drop every in-memory structure WITHOUT the orderly close's
+        final log fsync or Env unlock.  What survives is exactly what
+        reached stable storage; on a shared MemFS the companion call is
+        ``fs.crash(prefix)``, which also reverts unsynced bytes and
+        releases the dead process's file locks."""
+        with self.mu:
+            self._stopped = True
+            if self.fatal_error is None:
+                self.fatal_error = RequestError("simulated process kill")
+            nodes = list(self.nodes.values())
+            self.nodes.clear()
+            self._replica_specs.clear()
+        if self.mesh_engine is not None:
+            from dragonboat_tpu.engine.mesh_engine import detach_mesh_engine
+
+            for n in nodes:
+                if getattr(n, "engine", None) is self.mesh_engine:
+                    self.mesh_engine.remove_replica(n)
+            detach_mesh_engine(self.mesh_engine)
+            self.mesh_engine = None
+        self._work.set()
+        for ev in self._worker_events:
+            ev.set()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=5)
+        for t in self._workers:
+            t.join(timeout=5)
+        # brief drain so sm.close() cannot race an in-flight update()
+        # on these in-process threads (a real kill has no such race)
+        for n in nodes:
+            self._apply_pool.flush(n.shard_id, timeout=1)
+        self._apply_pool.stop()
+        for n in nodes:
+            n.destroy()
+        self.transport.close()
+        self.events.close()
+        close_registry = getattr(self.registry, "close", None)
+        if close_registry is not None:
+            close_registry()
+        # deliberately NOT closed: self.logdb (its close() fsyncs — a
+        # dead process never runs it) and self.env (the kernel releases
+        # a dead process's flocks; MemFS.crash models that)
 
     def start_replica(self, initial_members: dict[int, str], join: bool,
                       create_sm, cfg: Config) -> None:
@@ -347,6 +477,8 @@ class NodeHost:
             for rid, addr in {**m.addresses, **m.non_votings, **m.witnesses}.items():
                 self.registry.add(cfg.shard_id, rid, addr)
             self.nodes[cfg.shard_id] = node
+            self._replica_specs[cfg.shard_id] = (
+                dict(initial_members), join, create_sm, cfg)
         if mesh:
             self._inject_mesh_shard(node, members)
         elif device:
@@ -359,6 +491,7 @@ class NodeHost:
     def stop_replica(self, shard_id: int) -> None:
         with self.mu:
             node = self.nodes.pop(shard_id, None)
+            self._replica_specs.pop(shard_id, None)
         if node is None:
             raise ShardNotFoundError(f"shard {shard_id} not found")
         if self.mesh_engine is not None and getattr(

@@ -74,10 +74,12 @@ class ChanTransport(ITransport):
         self.running = False
         self.partitioned = False  # monkey-test hook (monkey.go:170)
         # test hooks (monkey transport hooks :83-89): drop predicate,
-        # per-message delay (seconds), and seeded in-batch reordering
+        # per-message delay (seconds), seeded in-batch reordering, and
+        # duplicate injection (raft must tolerate at-least-once delivery)
         self.drop_predicate: Callable[[pb.Message], bool] | None = None
         self.delay_func: Callable[[pb.Message], float] | None = None
         self.reorder_rng = None  # random.Random; shuffles batch requests
+        self.duplicate_predicate: Callable[[pb.Message], bool] | None = None
 
     def name(self) -> str:
         return "chan-transport"
@@ -102,6 +104,9 @@ class ChanTransport(ITransport):
         reqs = batch.requests
         if self.drop_predicate is not None:
             reqs = tuple(m for m in reqs if not self.drop_predicate(m))
+        if self.duplicate_predicate is not None:
+            reqs = reqs + tuple(
+                m for m in reqs if self.duplicate_predicate(m))
         if self.reorder_rng is not None and len(reqs) > 1:
             shuffled = list(reqs)
             self.reorder_rng.shuffle(shuffled)

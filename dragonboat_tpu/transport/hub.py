@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
+from random import Random
 from typing import Callable
 
 from dragonboat_tpu import raftpb as pb
@@ -19,6 +21,8 @@ from dragonboat_tpu.raftio import INodeRegistry, ITransport, SnapshotInfo
 
 SEND_QUEUE_LEN = 1024 * 2
 BREAKER_RESET_SECONDS = 1.0
+BREAKER_MAX_RESET_SECONDS = 30.0
+BREAKER_JITTER = 0.25
 
 
 def _msg_size(m: pb.Message) -> int:
@@ -27,23 +31,67 @@ def _msg_size(m: pb.Message) -> int:
 
 
 class CircuitBreaker:
-    """Minimal failure breaker (transport.go GetCircuitBreaker)."""
+    """Failure breaker with capped exponential backoff
+    (transport.go GetCircuitBreaker).
 
-    def __init__(self, reset_after: float = BREAKER_RESET_SECONDS) -> None:
-        self.reset_after = reset_after        # guarded-by: <init-only>
+    closed --fail()--> open --cooldown elapses--> half-open, where
+    ``ready()`` returns True and the next outcome decides: ``succeed()``
+    closes the breaker and resets the backoff; another ``fail()``
+    re-opens it with a doubled cooldown, capped at ``max_reset``.  A
+    fixed ``reset_after`` makes every breaker in a partitioned fleet
+    retry in lockstep, hammering a recovering peer once a second —
+    backoff spreads the probes out, and the jitter decorrelates
+    breakers that tripped on the same tick.  The jitter is drawn from a
+    per-breaker seeded PRNG, so a fault schedule replayed with the same
+    seeds observes the same cooldowns (the chaos harness depends on
+    this).
+
+    ``now`` parameters exist for deterministic unit tests; production
+    callers omit them and get the monotonic clock.
+    """
+
+    def __init__(self, reset_after: float = BREAKER_RESET_SECONDS,
+                 max_reset: float = BREAKER_MAX_RESET_SECONDS,
+                 seed: int = 0) -> None:
+        self.base_reset = reset_after         # guarded-by: <init-only>
+        self.max_reset = max_reset            # guarded-by: <init-only>
+        self.reset_after = reset_after        # guarded-by: mu (current cooldown)
         self.tripped_at = 0.0                 # guarded-by: mu
+        self.trip_streak = 0                  # guarded-by: mu
+        self._rng = Random(seed)              # guarded-by: mu
         self.mu = threading.Lock()
 
-    def ready(self) -> bool:
+    def ready(self, now: float | None = None) -> bool:
+        if now is None:
+            now = time.monotonic()
         with self.mu:
-            return (time.monotonic() - self.tripped_at) >= self.reset_after
+            return (now - self.tripped_at) >= self.reset_after
 
-    def fail(self) -> None:
+    def state(self, now: float | None = None) -> str:
+        """closed | open | half-open — observability + test surface."""
+        if now is None:
+            now = time.monotonic()
         with self.mu:
-            self.tripped_at = time.monotonic()
+            if self.trip_streak == 0:
+                return "closed"
+            if (now - self.tripped_at) >= self.reset_after:
+                return "half-open"
+            return "open"
+
+    def fail(self, now: float | None = None) -> None:
+        if now is None:
+            now = time.monotonic()
+        with self.mu:
+            self.trip_streak += 1
+            cooldown = self.base_reset * (2 ** min(self.trip_streak - 1, 30))
+            cooldown *= 1.0 + BREAKER_JITTER * self._rng.random()
+            self.reset_after = min(cooldown, self.max_reset)
+            self.tripped_at = now
 
     def succeed(self) -> None:
         with self.mu:
+            self.trip_streak = 0
+            self.reset_after = self.base_reset
             self.tripped_at = 0.0
 
 
@@ -107,8 +155,19 @@ class TransportHub:
         with self.mu:
             b = self.breakers.get(addr)
             if b is None:
-                b = self.breakers[addr] = CircuitBreaker()
+                # per-addr deterministic jitter seed: replaying a fault
+                # schedule sees identical cooldown sequences per peer
+                b = self.breakers[addr] = CircuitBreaker(
+                    seed=zlib.crc32(addr.encode()))
             return b
+
+    def trip_breaker(self, addr: str, count: int = 1) -> CircuitBreaker:
+        """Force ``count`` failures onto the breaker for ``addr`` — the
+        chaos harness's forced-trip fault (monkey.go breaker kicks)."""
+        b = self.breaker(addr)
+        for _ in range(count):
+            b.fail()
+        return b
 
     def send(self, m: pb.Message) -> bool:
         """Enqueue and (synchronously, in the loopback runtime) flush one

@@ -150,6 +150,8 @@ class _ConnProxy(IConnection):
         pass
 
     def _call(self, fn_name: str, arg) -> None:
+        if self.transport.partitioned:
+            raise ConnectionError(f"{self.transport.addr} partitioned")
         conn = self.transport._conn(self.target)
         try:
             getattr(conn, fn_name)(arg)
@@ -187,6 +189,9 @@ class TCPTransport(ITransport):
         self.client_ctx = client_ctx
         self.message_handler = message_handler
         self.chunk_handler = chunk_handler
+        # chaos-parity with ChanTransport: while True, inbound frames are
+        # read-and-discarded and outbound sends fail (partition_node)
+        self.partitioned = False
         self.mu = threading.Lock()
         self.conns: dict[str, _TCPConn] = {}
         self.running = False
@@ -289,6 +294,8 @@ class TCPTransport(ITransport):
                 payload = _recv_exact(sock, size)
                 if zlib.crc32(payload) != pcrc:
                     raise ValueError("payload crc mismatch")
+                if self.partitioned:
+                    continue
                 if method == SNAPSHOT_TYPE and self.wire == "go":
                     # a reference peer's snapshot stream: decode the
                     # gogo-marshaled Chunk and hand it to the chunk

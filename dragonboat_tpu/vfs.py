@@ -269,17 +269,30 @@ class MemFS:
             self._locks.discard(f._path)
 
     # -- test surface --
-    def crash(self) -> None:
+    def crash(self, prefix: str | None = None) -> None:
         """Simulate power loss: revert every file to its last-synced
-        content; files never synced disappear.  Locks are released."""
+        content; files never synced disappear.  Locks are released.
+
+        ``prefix`` scopes the loss to one path subtree — the model for a
+        single process dying while other NodeHosts share this MemFS
+        (each host's data dir is a distinct subtree)."""
         with self._mu:
+            pfx = None if prefix is None else self._norm(prefix)
             for p in list(self._files):
+                if pfx is not None and not (
+                        p == pfx or p.startswith(pfx + os.sep)):
+                    continue
                 node = self._files[p]
                 if node.synced:
                     node.data = bytearray(node.synced)
                 else:
                     del self._files[p]
-            self._locks.clear()
+            if pfx is None:
+                self._locks.clear()
+            else:
+                self._locks = {p for p in self._locks
+                               if not (p == pfx or
+                                       p.startswith(pfx + os.sep))}
 
 
 # ---------------------------------------------------------------------------

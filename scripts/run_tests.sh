@@ -15,6 +15,12 @@ set -e
 cd "$(dirname "$0")/.."
 PY="${PYTHON:-python}"
 rc=0
+# fresh flaky-retry tally for this run; the chunked pytest processes
+# below each merge their counts into tests/.retry_report.json
+# (tests/conftest.py), and any module retrying >3x fails its chunk
+rm -f tests/.retry_report.json
+DBT_RETRY_REPORT_MERGE=1
+export DBT_RETRY_REPORT_MERGE
 run() {
     echo "== chunk: $* =="
     PYTHONPATH= "$PY" -m pytest "$@" -q || rc=$?
@@ -33,10 +39,16 @@ run tests/test_a*.py tests/test_b*.py tests/test_d*.py tests/test_e*.py \
     tests/test_f*.py tests/test_g*.py tests/test_h*.py tests/test_k*.py
 run tests/test_m*.py tests/test_n*.py tests/test_r*.py tests/test_s*.py \
     tests/test_t*.py tests/test_v*.py
+# chaos tier-1: the fault-injection unit/acceptance tests plus the
+# fixed-seed fast schedules; the long schedule sweep stays out of the
+# default run (`pytest tests/test_chaos_schedules.py -m slow` on demand)
+run tests/test_chaos_faults.py
+run tests/test_chaos_schedules.py -m chaos_fast
 # catch-all: any test file whose first letter the chunks above do not
 # enumerate (a future test_c*/test_i*/... must not silently never run)
 leftover=$(ls tests/test_*.py | grep -v \
     -e 'tests/test_zz_kernel_scale\.py' -e 'tests/test_zz_mesh_scale\.py' \
+    -e 'tests/test_chaos_' \
     -e 'tests/test_[abdefghkmnrstv]' || true)
 if [ -n "$leftover" ]; then
     # shellcheck disable=SC2086
