@@ -44,7 +44,9 @@ BENCH_STORM_DROP (storm shape), BENCH_DEVICE_SM=1 (full data path:
 committed writes applied to the device-resident KV state machine by the
 fused rsm-apply kernel, rsm/device_kv.py), BENCH_PALLAS=1 (with
 BENCH_DEVICE_SM: route the apply through the pallas block kernel,
-rsm/device_kv_pallas.py).
+rsm/device_kv_pallas.py), BENCH_TELEMETRY=1 (standalone mode: A-B
+overhead of the device-side fleet_stats telemetry reduction at the
+engine's decimation cadence — see run_telemetry_ab).
 """
 
 import json
@@ -957,6 +959,82 @@ def run_serve_bench() -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_telemetry_ab() -> None:
+    """BENCH_TELEMETRY=1: A-B overhead of the device-side fleet_stats
+    reduction (core/fleet.py) at the engine's decimation cadence.
+
+    Arm A runs the plain bench loop in ``every``-step launches; arm B
+    runs the identical launches plus one jitted ``fleet_stats`` call and
+    its host fetch per launch — exactly what KernelEngine's
+    ``_collect_fleet_stats`` adds every ``fleet_stats_every`` steps.
+    Arms are interleaved A,B,A,B,... (median-of-3 per arm) so box drift
+    lands on both.  Knobs: BENCH_TELEM_GROUPS (default 10000),
+    BENCH_TELEM_STEPS (120), BENCH_TELEM_EVERY (10)."""
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core import fleet
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_TELEM_GROUPS", "10000"))
+    steps = int(os.environ.get("BENCH_TELEM_STEPS", "120"))
+    every = max(1, int(os.environ.get("BENCH_TELEM_EVERY", "10")))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    state, box = elect_all(kp, replicas, state)
+
+    def window(with_stats: bool) -> float:
+        nonlocal state, box
+        t0 = time.time()
+        done = 0
+        while done < steps:
+            state, box = run_steps(kp, replicas, every, True, True,
+                                   state, box)
+            done += every
+            if with_stats:
+                fleet.stats_to_dict(fleet.fleet_stats(state, box.from_))
+        state.term.block_until_ready()
+        return time.time() - t0
+
+    # warm both executables (run_steps at `every`, fleet_stats) outside
+    # the timed windows
+    window(True)
+    a_walls, b_walls = [], []
+    for _ in range(3):
+        a_walls.append(window(False))
+        b_walls.append(window(True))
+    a = sorted(a_walls)[1]
+    b = sorted(b_walls)[1]
+    overhead_pct = (b - a) / a * 100.0
+    emit({
+        "metric": (f"fleet_stats step-latency overhead, {g} groups x "
+                   f"{replicas} replicas, decimation N={every}"),
+        "value": round(overhead_pct, 2),
+        "unit": "% vs uninstrumented step",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "replicas": replicas,
+            "steps_per_arm_window": steps,
+            "decimation_every": every,
+            "plain_wall_s": [round(x, 3) for x in a_walls],
+            "telemetry_wall_s": [round(x, 3) for x in b_walls],
+            "plain_step_ms": round(a / steps * 1e3, 3),
+            "telemetry_step_ms": round(b / steps * 1e3, 3),
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def run_cpu_subprocess(degraded_note: str | None) -> None:
     """Re-exec on CPU, STREAMING the child's lines through as they
     appear (an external kill then still leaves the child's provisional
@@ -986,6 +1064,14 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_TELEMETRY") == "1":
+        try:
+            run_telemetry_ab()
+        except Exception:
+            import traceback
+
+            fail("telemetry-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_SERVE") == "1":
         try:
             run_serve_bench()

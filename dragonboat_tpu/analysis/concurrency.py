@@ -51,6 +51,8 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/request.py",
     "dragonboat_tpu/events.py",
     "dragonboat_tpu/chaos/crashfs.py",
+    "dragonboat_tpu/telemetry.py",
+    "dragonboat_tpu/flight.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

@@ -38,6 +38,11 @@ DEFAULT_GLOBS = (
     "dragonboat_tpu/chaos/faultplan.py",
     "dragonboat_tpu/chaos/crashfs.py",
     "dragonboat_tpu/chaos/oracle.py",
+    # telemetry must never perturb a replay: no clocks, no randomness —
+    # instruments observe caller-supplied values, the flight recorder
+    # stamps records with a caller-side monotonic sequence
+    "dragonboat_tpu/telemetry.py",
+    "dragonboat_tpu/flight.py",
 )
 
 WALL_CLOCK = {

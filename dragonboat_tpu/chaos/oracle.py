@@ -26,6 +26,10 @@ from dataclasses import dataclass, field
 class OracleReport:
     ok: bool = True
     failures: list = field(default_factory=list)
+    # last flight-recorder records at failure time, attached by the
+    # RUNNER (this module stays pure — no clocks, no global recorder
+    # reads — so the determinism lint keeps covering it)
+    flight_tail: list = field(default_factory=list)
 
     def fail(self, msg: str) -> None:
         self.ok = False

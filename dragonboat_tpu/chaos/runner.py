@@ -22,6 +22,7 @@ import zlib
 from dataclasses import dataclass, field
 from random import Random
 
+from dragonboat_tpu import flight
 from dragonboat_tpu.chaos.crashfs import CrashPointFS
 from dragonboat_tpu.chaos.faultplan import FaultPlan, canonical_json
 from dragonboat_tpu.chaos.oracle import OracleReport, check_convergence
@@ -102,6 +103,10 @@ class _Cluster:
     addrs: dict = field(default_factory=dict)
     cfgs: dict = field(default_factory=dict)       # rid -> Config
     epochs: dict = field(default_factory=dict)     # rid -> restart epoch
+    # acked-proposal counters harvested from hosts REPLACED by a process
+    # restart (a fresh NodeHost starts a fresh registry at zero); the
+    # telemetry invariant sums these with every current host's counter
+    acked_base: dict = field(default_factory=dict)  # rid -> int
 
     SHARD = 1
 
@@ -124,6 +129,10 @@ class _Cluster:
 
     def _spawn(self, rid: int) -> None:
         """Fresh NodeHost (+ fresh CrashPointFS) over rid's MemFS."""
+        old = self.hosts.get(rid)
+        if old is not None:
+            self.acked_base[rid] = (self.acked_base.get(rid, 0)
+                                    + self._acked_counter(old))
         self.fss[rid] = CrashPointFS(self.mems[rid])
         nh = NodeHost(self._nhconfig(rid))
         cfg = Config(shard_id=self.SHARD, replica_id=rid, election_rtt=10,
@@ -151,9 +160,43 @@ class _Cluster:
             for addr in sorted(self.addrs.values()):
                 hub.breaker(addr).succeed()
 
+    # -- telemetry observations ------------------------------------------
+
+    @staticmethod
+    def _acked_counter(nh) -> int:
+        try:
+            snap = nh.events.metrics.snapshot()
+            return int(snap.get("raft.proposals_acked", 0))
+        except Exception:
+            return 0
+
+    def acked_total(self) -> int:
+        """Acked-proposal counter summed across every host epoch: dead
+        hosts' registries are still readable (snapshot is a pure dict
+        walk), and replaced hosts' counts live in ``acked_base``."""
+        total = sum(self.acked_base.values())
+        for rid in sorted(self.hosts):
+            total += self._acked_counter(self.hosts[rid])
+        return total
+
+    def leaderless_total(self) -> int:
+        """Sum of the ``fleet.leaderless_shards`` callback gauge over
+        live, unpartitioned hosts (evaluated through the legacy snapshot
+        view so this exercises the same path a scrape does)."""
+        total = 0
+        for rid in self.live_rids():
+            nh = self.hosts[rid]
+            if nh._partitioned:
+                continue
+            snap = nh.events.metrics.snapshot()
+            total += int(snap.get("fleet.leaderless_shards", 0))
+        return total
+
     # -- event execution -------------------------------------------------
 
     def execute(self, ev) -> dict:
+        flight.record(flight.CHAOS_FAULT, fault=ev.kind, target=ev.target,
+                      params=dict(ev.params))
         fn = getattr(self, "_ev_" + ev.kind)
         return fn(ev.target, dict(ev.params))
 
@@ -360,6 +403,32 @@ def run_schedule(seed: int, plan: FaultPlan | None = None,
             acked, cluster.journals(), applied_samples,
             cluster.hashes("sm"), cluster.hashes("session"),
             cluster.hashes("membership")))
+        # telemetry invariants — the observability layer must agree with
+        # the oracle's ground truth after every schedule:
+        # 1. every ack the workload observed is in some host's acked
+        #    counter (counters also see pump/genesis traffic, so >=)
+        acked_seen = cluster.acked_total()
+        if acked_seen < len(acked):
+            report.fail(f"acked-proposal counter {acked_seen} < "
+                        f"{len(acked)} oracle-observed acks — telemetry "
+                        "lost acked writes")
+        # 2. the leaderless gauge returns to 0 once converged (poll
+        #    briefly: a follower may learn the leader an append after
+        #    the journals equalize)
+        if converged:
+            deadline = time.time() + 5.0
+            leaderless = cluster.leaderless_total()
+            while leaderless and time.time() < deadline:
+                time.sleep(0.05)
+                leaderless = cluster.leaderless_total()
+            if leaderless:
+                report.fail(f"fleet.leaderless_shards gauge stuck at "
+                            f"{leaderless} after convergence")
+        if not report.ok:
+            # attach the flight-recorder tail so a failure report carries
+            # the recent structured transitions (leader changes, trips,
+            # chaos faults) alongside the oracle verdict
+            report.flight_tail = flight.RECORDER.tail(64)
     finally:
         cluster.close()
     return ScheduleResult(

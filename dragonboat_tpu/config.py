@@ -151,6 +151,10 @@ class ExpertConfig:
     kernel_compaction_overhead: int = 64
     # max device-resident shards per NodeHost (lanes of the batched state)
     kernel_capacity: int = 1024
+    # device-side fleet telemetry decimation: the engines run the jitted
+    # fleet_stats reduction (core/fleet.py) every N steps and fetch one
+    # small struct to host; 0 disables the reduction entirely
+    fleet_stats_every: int = 10
 
 
 @dataclass
@@ -179,6 +183,9 @@ class NodeHostConfig:
     cert_file: str = ""
     key_file: str = ""
     enable_metrics: bool = False
+    # /metrics listen address when enable_metrics is True; port 0 binds
+    # an ephemeral port (reported by NodeHost.metrics_address)
+    metrics_address: str = "127.0.0.1:0"
     notify_commit: bool = False
     max_send_queue_size: int = 0
     max_receive_queue_size: int = 0

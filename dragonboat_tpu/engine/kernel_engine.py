@@ -244,7 +244,8 @@ class KernelEngine:
 
     def __init__(self, kp: KP.KernelParams, capacity: int,
                  send_message, events: EventHub | None = None,
-                 election_rtt: int = 10, heartbeat_rtt: int = 1) -> None:
+                 election_rtt: int = 10, heartbeat_rtt: int = 1,
+                 fleet_stats_every: int = 10) -> None:
         self.kp = kp
         self.capacity = capacity
         self.send_message = send_message
@@ -314,6 +315,19 @@ class KernelEngine:
         self._step_timer = StepTimer(self.events.metrics,
                                      "engine.kernel_step")
         maybe_start_from_env()
+        # decimated device-side fleet telemetry (core/fleet.py): every N
+        # steps one jitted reduction over the resident state fetches ONE
+        # small struct to host; 0 disables
+        self.fleet_stats_every = max(0, int(fleet_stats_every))
+        self._fleet_countdown = self.fleet_stats_every
+        self.last_fleet: dict | None = None
+        # standalone engines (no NodeHost) still expose the device-only
+        # view; a NodeHost registers its merged host+device view over the
+        # same names FIRST in its __init__, so this is a no-op there
+        from dragonboat_tpu.core import fleet as _fleet
+
+        _fleet.register_exposition(self.events.metrics.registry,
+                                   lambda: self.last_fleet)
 
     # -- lane lifecycle ---------------------------------------------------
 
@@ -636,6 +650,11 @@ class KernelEngine:
                 with annotate("kernel_engine.process_outputs"):
                     self.state = state
                     self._process_outputs(nodes, out)
+            if self.fleet_stats_every > 0:
+                self._fleet_countdown -= 1
+                if self._fleet_countdown <= 0:
+                    self._fleet_countdown = self.fleet_stats_every
+                    self._collect_fleet_stats()
             return True
 
     def _is_registered(self, n: KernelNode) -> bool:
@@ -655,6 +674,21 @@ class KernelEngine:
         """Mesh engines carry a device-resident inbox between steps; the
         single-device engine rebuilds its inbox from host queues."""
         return False
+
+    def _fleet_inbox_from(self):
+        """[G, K] sender ids feeding the inbox-occupancy histogram; the
+        single-device engine's inbox is host-staged each step."""
+        return self._inbox_buf.from_
+
+    def _collect_fleet_stats(self) -> None:
+        """Decimated fleet telemetry: one jitted reduction over the
+        resident state, one small struct fetched to host (core/fleet.py).
+        Runs under engine.mu right after a step, so the state it reads is
+        exactly the state the step produced."""
+        from dragonboat_tpu.core import fleet as _fleet
+
+        stats = _fleet.fleet_stats(self.state, self._fleet_inbox_from())
+        self.last_fleet = _fleet.stats_to_dict(stats)
 
     def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
         return kernel_step(self.kp, self.state, inbox.to_device(),

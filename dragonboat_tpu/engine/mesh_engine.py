@@ -64,7 +64,7 @@ class MeshEngine(KernelEngine):
     device ``(ig, ir)`` the rows of its replica slot."""
 
     def __init__(self, kp: KP.KernelParams, spec: MeshSpec,
-                 events=None) -> None:
+                 events=None, fleet_stats_every: int = 10) -> None:
         devs = jax.devices()
         need = spec.g_size * spec.replicas
         if len(devs) < need:
@@ -78,7 +78,8 @@ class MeshEngine(KernelEngine):
             kp=kp, mesh=mesh, replicas=spec.replicas,
             n_local=spec.n_local, num_groups=spec.g_size * spec.n_local)
         total = self.cluster.total_rows
-        super().__init__(kp, total, send_message=None, events=events)
+        super().__init__(kp, total, send_message=None, events=events,
+                         fleet_stats_every=fleet_stats_every)
         # replica ids are fixed by the mesh addressing (route() targets
         # rid 1..R); rows keep them even while ABSENT
         rids = np.empty((total,), np.int32)
@@ -206,6 +207,10 @@ class MeshEngine(KernelEngine):
     def _device_pending(self) -> bool:
         return self._pending_msgs > 0
 
+    def _fleet_inbox_from(self):
+        # the mesh inbox is device-resident between steps; no host copy
+        return self.box.from_
+
     def _kernel_call(self, inbox, inp):
         """Advance the mesh: host-staged inputs, device-routed messages.
         The host inbox builder is ignored — kernel-family traffic for
@@ -328,11 +333,13 @@ _REG_MU = threading.Lock()
 
 
 def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
-                       events=None) -> MeshEngine:
+                       events=None, fleet_stats_every: int = 10
+                       ) -> MeshEngine:
     with _REG_MU:
         eng = _REGISTRY.get(spec.name)
         if eng is None:
-            eng = MeshEngine(kp, spec, events=events)
+            eng = MeshEngine(kp, spec, events=events,
+                             fleet_stats_every=fleet_stats_every)
             _REGISTRY[spec.name] = eng
         else:
             if eng.spec != spec:

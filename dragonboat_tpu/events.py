@@ -5,18 +5,24 @@ are invoked from a dedicated worker thread so a slow listener can never
 stall the engine (event.go:54-90 runs listeners on the events goroutine).
 Exceptions from listeners are logged and swallowed.
 
-Metrics: a process-wide counter registry analogous to the reference's
-Prometheus surface (event.go metrics + nodehost metrics); exported as a
-plain dict snapshot so any exporter can scrape it.
+Metrics: the legacy ``inc``/``set``/``snapshot`` counter surface is now
+a compat shim over the typed instrument registry in
+``dragonboat_tpu/telemetry.py`` (Counter/Gauge/Histogram + Prometheus
+exposition).  Legacy dotted names keep working and keep their exact
+keys in ``snapshot()``; a wrong-typed operation on a name (``inc`` on a
+gauge, ``set`` on a counter) logs once and falls back to the old
+defaultdict semantics instead of raising, so unmigrated callers degrade
+instead of crashing — new code should use ``metrics.registry``
+directly and gets the strict typed behavior.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from collections import defaultdict
 from typing import Callable
 
+from dragonboat_tpu import flight
 from dragonboat_tpu.logger import get_logger
 from dragonboat_tpu.raftio import (
     EntryInfo,
@@ -24,28 +30,66 @@ from dragonboat_tpu.raftio import (
     NodeInfo,
     SnapshotInfo,
 )
+from dragonboat_tpu.telemetry import InstrumentTypeError, Registry
 
 _LOG = get_logger("events")
 
 
 class Metrics:
-    """Process-wide counters (reference: Prometheus registry)."""
+    """Legacy counter facade over a typed ``telemetry.Registry``.
 
-    def __init__(self) -> None:
-        self.mu = threading.Lock()
-        self.counters: dict[str, int] = defaultdict(int)   # guarded-by: mu
+    ``inc(name)`` lazily registers a Counter, ``set(name)`` a Gauge,
+    ``observe(name)`` a Histogram.  A name already registered as the
+    other kind is the old counter/gauge conflation bug — the shim logs
+    one warning per (op, name) and applies the legacy defaultdict
+    semantics so existing callers keep running while they migrate.
+    """
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry if registry is not None else Registry()
+        # legacy alias: old code synchronized on metrics.mu
+        self.mu = self.registry.mu
+        self._warn_mu = threading.Lock()
+        self._warned: set[tuple[str, str]] = set()    # guarded-by: _warn_mu
+
+    def _warn_once(self, op: str, name: str, use: str) -> None:
+        with self._warn_mu:
+            if (op, name) in self._warned:
+                return
+            self._warned.add((op, name))
+        _LOG.warning(
+            "legacy %s() on %r which is registered as a %s — applying "
+            "defaultdict semantics; migrate the caller to the typed "
+            "registry", op, name, use)
 
     def inc(self, name: str, delta: int = 1) -> None:
-        with self.mu:
-            self.counters[name] += delta
+        try:
+            self.registry.counter(name).inc(delta)
+        except InstrumentTypeError:
+            self._warn_once("inc", name, self.registry.kind_of(name))
+            try:
+                self.registry.gauge(name)._force_add(delta)
+            except InstrumentTypeError:
+                pass        # histogram / callback gauge: drop the inc
 
     def set(self, name: str, value: int) -> None:
-        with self.mu:
-            self.counters[name] = value
+        try:
+            self.registry.gauge(name).set(value)
+        except InstrumentTypeError:
+            self._warn_once("set", name, self.registry.kind_of(name))
+            try:
+                self.registry.counter(name)._force_set(value)
+            except InstrumentTypeError:
+                pass        # histogram / callback gauge: drop the set
 
-    def snapshot(self) -> dict[str, int]:
-        with self.mu:
-            return dict(self.counters)
+    def observe(self, name: str, value, buckets=None) -> None:
+        if buckets is not None:
+            self.registry.histogram(name, buckets=buckets).observe(value)
+        else:
+            self.registry.histogram(name).observe(value)
+
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
 
 
 class EventHub:
@@ -92,6 +136,9 @@ class EventHub:
 
     def leader_updated(self, info: LeaderInfo) -> None:
         self.metrics.inc("raft.leader_updated")
+        flight.record(flight.LEADER_CHANGE, shard_id=info.shard_id,
+                      replica_id=info.replica_id, term=int(info.term),
+                      leader_id=int(info.leader_id))
         self._dispatch(self.raft_listener, "leader_updated", info)
 
     # -- system events (listener.go:59-76) ------------------------------
@@ -124,6 +171,10 @@ class EventHub:
                        addr, snapshot)
 
     def send_snapshot_started(self, info: SnapshotInfo) -> None:
+        flight.record(flight.SNAPSHOT, phase="send_started",
+                      shard_id=info.shard_id, replica_id=info.replica_id,
+                      to=info.from_, index=int(info.index),
+                      term=int(info.term))
         self._dispatch(self.system_listener, "send_snapshot_started", info)
 
     def send_snapshot_completed(self, info: SnapshotInfo) -> None:
@@ -134,6 +185,10 @@ class EventHub:
 
     def snapshot_received(self, info: SnapshotInfo) -> None:
         self.metrics.inc("snapshot.received")
+        flight.record(flight.SNAPSHOT, phase="received",
+                      shard_id=info.shard_id, replica_id=info.replica_id,
+                      from_=info.from_, index=int(info.index),
+                      term=int(info.term))
         self._dispatch(self.system_listener, "snapshot_received", info)
 
     def snapshot_recovered(self, info: SnapshotInfo) -> None:
@@ -142,6 +197,9 @@ class EventHub:
 
     def snapshot_created(self, info: SnapshotInfo) -> None:
         self.metrics.inc("snapshot.created")
+        flight.record(flight.SNAPSHOT, phase="created",
+                      shard_id=info.shard_id, replica_id=info.replica_id,
+                      index=int(info.index), term=int(info.term))
         self._dispatch(self.system_listener, "snapshot_created", info)
 
     def snapshot_compacted(self, info: SnapshotInfo) -> None:

@@ -25,12 +25,23 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Sequence
 
+from dragonboat_tpu import flight
 from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu import telemetry
 from dragonboat_tpu.raftio import ILogDB, NodeInfo, RaftState
+
+# write-path latency lives in the process-global registry: tan shards are
+# module-scoped single writers, not per-NodeHost objects, and a scrape
+# wants the host-wide durability picture in one family
+_SAVE_US = telemetry.GLOBAL.histogram(
+    "logdb.save_us", help="save_raft_state batch latency (append+fsync), us")
+_FSYNC_US = telemetry.GLOBAL.histogram(
+    "logdb.fsync_us", help="fsync latency at the durability point, us")
 
 MAGIC = 0x7A4E0002
 _HDR = struct.Struct("<III")          # magic, payload length, crc32
@@ -254,7 +265,9 @@ class TanLogDB(ILogDB):
 
     def _sync(self) -> None:
         """THE fsync (engine.go:1343 SaveRaftState durability point)."""
+        t0 = time.perf_counter()
         self.fs.fsync(self._active)
+        _FSYNC_US.observe((time.perf_counter() - t0) * 1e6)
 
     # -- recovery --------------------------------------------------------
 
@@ -306,6 +319,8 @@ class TanLogDB(ILogDB):
                 with self.fs.open(path, "r+b") as tf:
                     tf.truncate(scan_end)
                 self.quarantined.append(f"{path}@{scan_end}")
+                flight.record(flight.QUARANTINE, path=path,
+                              truncated_at=scan_end)
                 return
             raise CorruptLogError(
                 f"{path}@{scan_end}: bad record in non-tail log file")
@@ -398,6 +413,7 @@ class TanLogDB(ILogDB):
     def save_raft_state(self, updates: Sequence[pb.Update],
                         worker_id: int) -> None:
         """Batch append + ONE fsync (raftio/logdb.go:78-83)."""
+        t0 = time.perf_counter()
         with self._mu:
             wrote = False
             for ud in updates:
@@ -410,6 +426,8 @@ class TanLogDB(ILogDB):
                 wrote = True
             if wrote:
                 self._sync()
+        if wrote:
+            _SAVE_US.observe((time.perf_counter() - t0) * 1e6)
 
     def _apply_record_index(self, fileno: int, off: int,
                             ud: pb.Update) -> None:

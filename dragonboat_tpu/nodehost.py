@@ -153,6 +153,15 @@ class NodeHost:
         )
         self.mu = threading.RLock()
         self.nodes: dict[int, Node] = {}
+        # merged fleet telemetry view: host-resident replicas recounted
+        # at scrape time + the engines' decimated device reductions
+        # (core/fleet.py).  Registered BEFORE any engine exists, so the
+        # engines' standalone device-only registration no-ops on this
+        # registry and the merged view owns the family names
+        from dragonboat_tpu.core import fleet as _fleet
+
+        _fleet.register_exposition(self.events.metrics.registry,
+                                   self._fleet_snapshot, replace=True)
         # a directly-injected ILogDB object cannot be reopened by
         # restart() (no recipe to rebuild it); factories can
         self._injected_logdb = logdb is not None
@@ -222,9 +231,61 @@ class NodeHost:
         self._apply_pool = ApplyPool(
             num_workers=max(1, min(nhconfig.expert.engine.apply_shards, 16)),
             on_work_done=self._work.set, name=f"apply-{self.id[:8]}")
+        # opt-in Prometheus /metrics endpoint (enable_metrics): serves
+        # this host's registry + the process-global one (module-scoped
+        # producers like the logdb latency histograms live there)
+        self._metrics_server = None
+        if nhconfig.enable_metrics:
+            from dragonboat_tpu.server.metrics_http import MetricsServer
+            from dragonboat_tpu.telemetry import GLOBAL
+
+            self._metrics_server = MetricsServer(
+                [self.events.metrics.registry, GLOBAL],
+                address=nhconfig.metrics_address or "127.0.0.1:0")
+            _LOG.info("NodeHost %s metrics endpoint on %s",
+                      nhconfig.raft_address, self._metrics_server.address)
         self._auto_run = auto_run
         if auto_run:
             self._start_engine_threads()
+
+    @property
+    def metrics_address(self) -> str | None:
+        """The bound host:port of the /metrics endpoint (None when
+        enable_metrics is off)."""
+        return (self._metrics_server.address
+                if self._metrics_server is not None else None)
+
+    def _fleet_snapshot(self) -> dict:
+        """Scrape-time fleet view: the engines' cached device reductions
+        merged with a host-side recount of host-resident replicas (a
+        plain 3-replica cluster has no device state to reduce, but
+        /metrics must still answer role/leaderless/lag questions)."""
+        from dragonboat_tpu.core import fleet as _fleet
+
+        base = _fleet.empty_dict()
+        for eng in (self.kernel_engine, self.mesh_engine):
+            d = getattr(eng, "last_fleet", None)
+            if d:
+                _fleet.merge_into(base, d)
+        with self.mu:
+            nodes = list(self.nodes.values())
+        for n in nodes:
+            if getattr(n, "engine", None) is not None:
+                continue        # device-resident: covered by the reduction
+            try:
+                raft = n.peer.raft if n.peer is not None else None
+                if raft is None:
+                    _fleet.add_host_shard(base, "follower", False, 0, 0)
+                    continue
+                lag = max(0, int(raft.log.committed)
+                          - int(raft.log.processed))
+                _fleet.add_host_shard(
+                    base, raft.state.name.lower(),
+                    int(raft.leader_id) == 0, int(raft.term), lag)
+            except Exception:
+                # a replica being torn down mid-scrape still counts
+                _fleet.add_host_shard(base, "follower", False, 0, 0)
+        return base
 
     def _start_engine_threads(self) -> None:
         """Spawn the engine ticker + step workers (also from restart()).
@@ -290,6 +351,9 @@ class NodeHost:
         for n in nodes:
             n.destroy()
             self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         self.transport.close()
         try:
             self.logdb.close()
@@ -412,6 +476,9 @@ class NodeHost:
         self._apply_pool.stop()
         for n in nodes:
             n.destroy()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         self.transport.close()
         self.events.close()
         close_registry = getattr(self.registry, "close", None)
@@ -519,7 +586,8 @@ class NodeHost:
             ex = self.config.expert
             self.kernel_engine = KernelEngine(
                 self._kernel_params(), ex.kernel_capacity,
-                self._send_message, events=self.events)
+                self._send_message, events=self.events,
+                fleet_stats_every=ex.fleet_stats_every)
             self.kernel_engine.on_evict = self._on_kernel_evict
         init = self._build_lane_init(node, members)
         self._inject_into_engine(self.kernel_engine, node, init,
@@ -617,8 +685,9 @@ class NodeHost:
         if self.mesh_engine is None:
             try:
                 kp = self._kernel_params(min_inbox=5 * (spec.replicas - 1))
-                self.mesh_engine = attach_mesh_engine(kp, spec,
-                                                      events=self.events)
+                self.mesh_engine = attach_mesh_engine(
+                    kp, spec, events=self.events,
+                    fleet_stats_every=self.config.expert.fleet_stats_every)
             except Exception as e:
                 # not enough devices, or geometry mismatch with an
                 # already-attached engine
@@ -998,6 +1067,11 @@ class NodeHost:
                      timeout_s: float = DEFAULT_TIMEOUT_S) -> Result:
         rs = self.propose(session, cmd, timeout_s)
         result = rs.get(timeout_s)
+        # acked-write accounting: rs.get raised on anything but a
+        # committed+applied proposal, so this counts exactly the writes
+        # a client may rely on (the chaos telemetry invariant checks it
+        # against the oracle's committed-entry count)
+        self.events.metrics.inc("raft.proposals_acked")
         if not session.is_noop_session():
             session.proposal_completed()
         return result

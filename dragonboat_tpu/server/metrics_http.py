@@ -1,0 +1,85 @@
+"""Stdlib-http /metrics endpoint (opt-in via NodeHostConfig.enable_metrics).
+
+Serves the Prometheus text exposition of one or more
+``telemetry.Registry`` objects (a NodeHost serves its per-hub registry
+concatenated with the process-global one that module-scoped producers
+like the logdb engines write to), plus ``/flight`` — the flight
+recorder tail as JSON — and ``/healthz``.
+
+A ``ThreadingHTTPServer`` on a daemon thread: scrapes never run on an
+engine thread, and the collect path takes no registry lock while
+evaluating callback gauges (see telemetry.Registry.collect), so a
+scrape cannot invert against engine-held host locks.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragonboat_tpu import flight
+from dragonboat_tpu.logger import get_logger
+
+_LOG = get_logger("metrics_http")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """One /metrics listener over a list of registries."""
+
+    def __init__(self, registries, address: str = "127.0.0.1:0",
+                 flight_recorder=None) -> None:
+        self.registries = list(registries)
+        self.flight_recorder = (flight_recorder if flight_recorder
+                                is not None else flight.RECORDER)
+        host, _, port = address.rpartition(":")
+        if not host:
+            host, port = address or "127.0.0.1", "0"
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:          # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer.render().encode("utf-8")
+                    ctype = CONTENT_TYPE
+                elif path == "/flight":
+                    body = (outer.flight_recorder.dump_json(indent=2)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:
+                _LOG.debug("metrics http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"metrics-http-{self._httpd.server_address[1]}",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def render(self) -> str:
+        return "".join(r.exposition() for r in self.registries)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=2)
+        self._httpd.server_close()

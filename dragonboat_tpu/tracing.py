@@ -28,8 +28,17 @@ _active_trace_dir: str | None = None
 
 
 def start_trace(trace_dir: str) -> None:
-    """Begin a JAX profiler capture into ``trace_dir``."""
+    """Begin a JAX profiler capture into ``trace_dir``.
+
+    Raises ``RuntimeError`` when a capture is already active: the JAX
+    profiler is a process singleton, and silently overwriting
+    ``_active_trace_dir`` would make ``stop_trace`` report the second
+    dir while the capture file lands in the first."""
     global _active_trace_dir
+    if _active_trace_dir is not None:
+        raise RuntimeError(
+            f"a trace is already active in {_active_trace_dir!r}; call "
+            "stop_trace() before starting another capture")
     import jax
 
     jax.profiler.start_trace(trace_dir)
@@ -48,16 +57,39 @@ def stop_trace() -> str | None:
     return d
 
 
+_env_hook_registered = False
+
+
+def _atexit_stop() -> None:
+    """atexit wrapper: an env-armed capture may already have been
+    stopped by hand, and interpreter-shutdown stops must never mask the
+    real exit path with a profiler error."""
+    try:
+        stop_trace()
+    except Exception:
+        pass
+
+
 def maybe_start_from_env() -> bool:
     """Arm capture when DRAGONBOAT_TPU_TRACE_DIR is set (idempotent).
     JAX only serializes the capture on stop, so an env-armed trace
-    registers an atexit stop — otherwise the dir would stay empty."""
+    registers an atexit stop — otherwise the dir would stay empty.
+
+    Ordering: atexit hooks run LIFO, so the stop hook must be
+    registered AFTER the engine/JAX import chain has registered its own
+    teardown (backend shutdown) — i.e. here, after ``start_trace`` has
+    imported jax — or the profiler would try to serialize the capture
+    into an already-torn-down backend.  The hook is registered exactly
+    once per process."""
+    global _env_hook_registered
     d = os.environ.get("DRAGONBOAT_TPU_TRACE_DIR")
     if d and _active_trace_dir is None:
         import atexit
 
-        start_trace(d)
-        atexit.register(stop_trace)
+        start_trace(d)          # imports jax; its atexit hooks exist now
+        if not _env_hook_registered:
+            _env_hook_registered = True
+            atexit.register(_atexit_stop)
         return True
     return False
 
@@ -78,13 +110,16 @@ def annotate(name: str):
 class StepTimer:
     """Step-latency accounting into a Metrics registry.
 
-    Keeps an exponentially-weighted mean and the max in integer
-    microseconds so the snapshot stays a plain counter dict."""
+    Typed instruments via the events.Metrics facade: ``.steps`` /
+    ``.total_us`` are counters, ``.ewma_us`` / ``.max_us`` gauges, and
+    ``.latency_us`` a fixed-bucket histogram for the Prometheus
+    exposition; the legacy snapshot keys are unchanged."""
 
     def __init__(self, metrics, prefix: str) -> None:
         self.metrics = metrics
         self.prefix = prefix
         self._ewma_us = 0.0
+        self._max_us = 0
 
     @contextlib.contextmanager
     def measure(self):
@@ -93,11 +128,10 @@ class StepTimer:
         us = (time.perf_counter() - t0) * 1e6
         self._ewma_us = us if self._ewma_us == 0 else (
             0.9 * self._ewma_us + 0.1 * us)
+        self._max_us = max(self._max_us, int(us))
         m = self.metrics
         m.inc(f"{self.prefix}.steps")
         m.inc(f"{self.prefix}.total_us", int(us))
-        with m.mu:
-            key = f"{self.prefix}.ewma_us"
-            m.counters[key] = int(self._ewma_us)
-            key = f"{self.prefix}.max_us"
-            m.counters[key] = max(m.counters.get(key, 0), int(us))
+        m.set(f"{self.prefix}.ewma_us", int(self._ewma_us))
+        m.set(f"{self.prefix}.max_us", self._max_us)
+        m.observe(f"{self.prefix}.latency_us", us)

@@ -15,6 +15,7 @@ from collections import deque
 from random import Random
 from typing import Callable
 
+from dragonboat_tpu import flight
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.raftio import INodeRegistry, ITransport, SnapshotInfo
@@ -78,15 +79,19 @@ class CircuitBreaker:
                 return "half-open"
             return "open"
 
-    def fail(self, now: float | None = None) -> None:
+    def fail(self, now: float | None = None) -> bool:
+        """Record one failure; returns True when this failure OPENED a
+        closed breaker (the closed->open edge, for trip accounting)."""
         if now is None:
             now = time.monotonic()
         with self.mu:
             self.trip_streak += 1
+            opened = self.trip_streak == 1
             cooldown = self.base_reset * (2 ** min(self.trip_streak - 1, 30))
             cooldown *= 1.0 + BREAKER_JITTER * self._rng.random()
             self.reset_after = min(cooldown, self.max_reset)
             self.tripped_at = now
+        return opened
 
     def succeed(self) -> None:
         with self.mu:
@@ -134,6 +139,29 @@ class TransportHub:
         self.connected: dict[tuple[str, bool], bool] = {}           # guarded-by: mu
         # counters live in the shared process-wide registry (events.Metrics)
         self.metrics = self.events.metrics
+        registry = getattr(self.metrics, "registry", None)
+        if registry is not None:
+            registry.gauge_fn(
+                "transport.breakers", self._breaker_states,
+                help="per-address circuit breakers by current state",
+                labelnames=("state",))
+
+    def _breaker_states(self) -> dict[tuple[str, ...], float]:
+        """Callback-gauge source: breaker count per state.  Copies the
+        breaker map under ``mu`` and evaluates ``b.state()`` (which takes
+        each breaker's own lock) after releasing it — the scrape thread
+        never holds two locks at once."""
+        with self.mu:
+            breakers = list(self.breakers.values())
+        counts = {"closed": 0, "open": 0, "half-open": 0}
+        for b in breakers:
+            counts[b.state()] += 1
+        return {(state,): float(n) for state, n in counts.items()}
+
+    def _record_trip(self, addr: str) -> None:
+        """closed->open edge accounting (called when ``fail()`` opened)."""
+        self.metrics.inc("transport.breaker_trips")
+        flight.record(flight.BREAKER_TRIP, addr=addr)
 
     def _note_connection(self, addr: str, ok: bool, snapshot: bool) -> None:
         """Edge-triggered ConnectionEstablished/Failed events, keyed per
@@ -166,7 +194,8 @@ class TransportHub:
         chaos harness's forced-trip fault (monkey.go breaker kicks)."""
         b = self.breaker(addr)
         for _ in range(count):
-            b.fail()
+            if b.fail():
+                self._record_trip(addr)
         return b
 
     def send(self, m: pb.Message) -> bool:
@@ -224,7 +253,8 @@ class TransportHub:
                 self.metrics.inc("transport.sent", len(msgs))
                 self._note_connection(a, True, False)
             except Exception:
-                b.fail()
+                if b.fail():
+                    self._record_trip(a)
                 self.metrics.inc("transport.send_failed", len(msgs))
                 self._note_connection(a, False, False)
                 for m in msgs:
@@ -298,7 +328,8 @@ class TransportHub:
             self.events.send_snapshot_completed(info)
             return True
         except Exception:
-            b.fail()
+            if b.fail():
+                self._record_trip(addr)
             self._note_connection(addr, False, True)
             self.events.send_snapshot_aborted(info)
             self._notify_unreachable(m)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""One-shot /metrics scraper for a running NodeHost.
+
+Fetches the Prometheus text exposition from a NodeHost's opt-in
+metrics endpoint (NodeHostConfig.enable_metrics / metrics_address),
+validates it with the repo's strict parser (telemetry.parse_exposition
+— the same one the round-trip golden test uses), and prints either the
+raw text or a flat JSON object.
+
+    python scripts/metrics_dump.py 127.0.0.1:9090
+    python scripts/metrics_dump.py 127.0.0.1:9090 --json
+    python scripts/metrics_dump.py 127.0.0.1:9090 --flight
+
+Stdlib-only on the wire (urllib); exit status is non-zero when the
+endpoint is unreachable or the exposition fails strict parsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch(address: str, path: str, timeout: float) -> str:
+    url = f"http://{address}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("address", help="host:port of the /metrics endpoint")
+    ap.add_argument("--json", action="store_true",
+                    help="print samples as a flat JSON object instead of "
+                         "the raw exposition text")
+    ap.add_argument("--flight", action="store_true",
+                    help="dump /flight (the flight-recorder tail) instead "
+                         "of /metrics")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip strict exposition parsing")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args()
+
+    path = "/flight" if args.flight else "/metrics"
+    try:
+        text = fetch(args.address, path, args.timeout)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"error: cannot scrape http://{args.address}{path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.flight:
+        print(text, end="" if text.endswith("\n") else "\n")
+        return 0
+
+    families = None
+    if args.json or not args.no_validate:
+        from dragonboat_tpu.telemetry import parse_exposition
+
+        try:
+            families = parse_exposition(text)
+        except ValueError as e:
+            print(f"error: exposition failed strict parsing: {e}",
+                  file=sys.stderr)
+            return 1
+
+    if args.json:
+        flat = {}
+        for fam in sorted(families):
+            for sname, labels, value in families[fam]["samples"]:
+                key = sname
+                if labels:
+                    key += "{" + ",".join(
+                        f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+                flat[key] = value
+        print(json.dumps(flat, indent=2, sort_keys=True))
+    else:
+        print(text, end="" if text.endswith("\n") else "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,8 @@
 """Tracing hooks: step-latency accounting and profiler span no-ops."""
 
+import pytest
+
+from dragonboat_tpu import tracing
 from dragonboat_tpu.events import Metrics
 from dragonboat_tpu.tracing import StepTimer, annotate
 
@@ -15,9 +18,20 @@ def test_step_timer_feeds_metrics():
     assert snap["engine.test.total_us"] >= 0
     assert "engine.test.ewma_us" in snap
     assert snap["engine.test.max_us"] >= snap["engine.test.ewma_us"] // 2
+    # the typed registry also collects per-step latency as a histogram
+    assert snap["engine.test.latency_us.count"] == 3
 
 
 def test_annotate_is_safe_without_capture():
     with annotate("noop-span"):
         x = 1 + 1
     assert x == 2
+
+
+def test_double_start_trace_raises(tmp_path, monkeypatch):
+    """A second start_trace while one is active must raise a clear
+    error instead of silently clobbering _active_trace_dir (which would
+    make stop_trace report the wrong capture directory)."""
+    monkeypatch.setattr(tracing, "_active_trace_dir", str(tmp_path / "a"))
+    with pytest.raises(RuntimeError, match="already active"):
+        tracing.start_trace(str(tmp_path / "b"))
