@@ -46,7 +46,10 @@ fused rsm-apply kernel, rsm/device_kv.py), BENCH_PALLAS=1 (with
 BENCH_DEVICE_SM: route the apply through the pallas block kernel,
 rsm/device_kv_pallas.py), BENCH_TELEMETRY=1 (standalone mode: A-B
 overhead of the device-side fleet_stats telemetry reduction at the
-engine's decimation cadence — see run_telemetry_ab).
+engine's decimation cadence — see run_telemetry_ab), BENCH_PIPELINE=1
+(standalone mode: interleaved A-B of the serial vs fused depth-1
+pipelined step loops with commit-latency percentiles per arm — see
+run_pipeline_ab).
 """
 
 import json
@@ -1035,6 +1038,146 @@ def run_telemetry_ab() -> None:
     })
 
 
+def run_pipeline_ab() -> None:
+    """BENCH_PIPELINE=1: A-B of the serial depth-0 loop vs the fused
+    depth-1 pipelined loop (PR 6) at MATCHED micro-step counts — the
+    pipelined arm runs half as many fori iterations, each two fused
+    micro-steps, so both arms advance the protocol identically (they
+    are bitwise-equal loops, tests/test_pipeline_differential.py).
+
+    Phase 1 interleaves throughput windows A,B,A,B,... (median-of-3 per
+    arm, same policy as the headline bench) and reports step_ms +
+    writes/s per arm.  Phase 2 runs the instrumented latency loop per
+    arm and reports commit percentiles in each arm's OWN clock unit:
+    device steps for serial, pipeline steps for pipelined — raft's
+    propose->commit chain spans 2 micro-steps, so the pipelined arm's
+    p50 lands at <= 1 pipeline step where the serial arm needs 2.
+    Knobs: BENCH_PIPE_GROUPS (default 1024 — the BENCH_r06 comparison
+    geometry), BENCH_PIPE_STEPS (micro-steps per window, default 120),
+    BENCH_PIPE_LAT_STEPS (default max(40, steps // 2))."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        lat_init,
+        make_cluster,
+        run_steps,
+        run_steps_lat,
+        run_steps_lat_pipelined,
+        run_steps_pipelined,
+    )
+    from dragonboat_tpu.core import params as KP
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_PIPE_GROUPS", "1024"))
+    micro = int(os.environ.get("BENCH_PIPE_STEPS", "120"))
+    micro -= micro % 2
+    lat_steps = int(os.environ.get("BENCH_PIPE_LAT_STEPS",
+                                   str(max(40, micro // 2))))
+    lat_steps -= lat_steps % 2
+    kp = bench_params(replicas)
+    B = kp.proposal_cap
+    state0, box0 = elect_all(kp, replicas, make_cluster(kp, g, replicas))
+    lead = np.asarray(state0.role) == KP.LEADER
+
+    arms = {"serial": {"state": state0, "box": box0},
+            "pipelined": {"state": state0, "box": box0}}
+
+    def committed(st):
+        return np.asarray(st.committed)[lead].astype(np.int64).sum()
+
+    def window(arm):
+        a = arms[arm]
+        if arm == "serial":
+            def run():
+                a["state"], a["box"] = run_steps(
+                    kp, replicas, micro, True, True, a["state"], a["box"])
+        else:
+            def run():
+                a["state"], a["box"] = run_steps_pipelined(
+                    kp, replicas, micro // 2, True, True,
+                    a["state"], a["box"])
+        c0 = committed(a["state"])
+        t0 = time.time()
+        run()
+        a["state"].term.block_until_ready()
+        dt = time.time() - t0
+        w = int(committed(a["state"]) - c0)
+        return {"wall_s": round(dt, 3),
+                "micro_step_ms": round(dt / micro * 1e3, 3),
+                "writes": w,
+                "writes_per_s": round(w / dt)}
+
+    # warm both executables outside the timed windows
+    for arm in arms:
+        window(arm)
+    wins = {"serial": [], "pipelined": []}
+    for _ in range(3):
+        for arm in ("serial", "pipelined"):
+            wins[arm].append(window(arm))
+    med = {arm: sorted(ws, key=lambda r: r["micro_step_ms"])[1]
+           for arm, ws in wins.items()}
+
+    def lat_arm(arm):
+        a = arms[arm]
+        pipe = arm == "pipelined"
+        loop = run_steps_lat_pipelined if pipe else run_steps_lat
+        iters = lat_steps // 2 if pipe else lat_steps
+        stamp, hist, reads = lat_init(kp, a["state"].term.shape[0])
+        # warm the exact executable; its stamps stay in the baseline
+        st, bx, sp, hi, rd = loop(
+            kp, replicas, iters, B, False, True, True,
+            jnp.asarray(0, jnp.int32), a["state"], a["box"],
+            stamp, hist, reads)
+        hi0 = np.asarray(hi).astype(np.int64)
+        t0 = time.time()
+        st, bx, sp, hi, rd = loop(
+            kp, replicas, iters, B, False, True, True,
+            jnp.asarray(iters, jnp.int32), st, bx, sp, hi, rd)
+        st.term.block_until_ready()
+        dt = time.time() - t0
+        histw = np.asarray(hi).astype(np.int64) - hi0
+        # latency unit = this arm's dispatch clock; cost scaled to the
+        # UNinstrumented step_ms, as the headline latency phase does
+        unit_ms = med[arm]["micro_step_ms"] * (2 if pipe else 1)
+        out = {"unit": "pipeline steps" if pipe else "device steps",
+               "unit_step_ms": round(unit_ms, 3),
+               "instrumented_wall_s": round(dt, 3)}
+        for name, q in (("p50", 0.50), ("p99", 0.99), ("p99.9", 0.999)):
+            p = _pctile(histw, q)
+            out[name + "_steps"] = p
+            out[name + "_ms"] = (round(p * unit_ms, 3) if p is not None
+                                 else None)
+        return out
+
+    lat = {arm: lat_arm(arm) for arm in ("serial", "pipelined")}
+    s_ms, p_ms = med["serial"]["micro_step_ms"], med["pipelined"]["micro_step_ms"]
+    emit({
+        "metric": (f"pipelined vs serial step loop, {g} groups x "
+                   f"{replicas} replicas, 16B"),
+        "value": med["pipelined"]["writes_per_s"],
+        "unit": "writes/s (pipelined arm)",
+        "vs_baseline": round(med["pipelined"]["writes_per_s"]
+                             / BASELINE_WPS, 4),
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "micro_steps_per_window": micro,
+            "policy": "median-of-3 interleaved windows per arm",
+            "serial": {**med["serial"], "windows": wins["serial"],
+                       "commit_latency": lat["serial"]},
+            "pipelined": {**med["pipelined"], "windows": wins["pipelined"],
+                          "commit_latency": lat["pipelined"]},
+            "micro_step_ms_ratio": round(p_ms / s_ms, 4) if s_ms else None,
+        },
+    })
+
+
 def run_cpu_subprocess(degraded_note: str | None) -> None:
     """Re-exec on CPU, STREAMING the child's lines through as they
     appear (an external kill then still leaves the child's provisional
@@ -1064,6 +1207,14 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_PIPELINE") == "1":
+        try:
+            run_pipeline_ab()
+        except Exception:
+            import traceback
+
+            fail("pipeline-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_TELEMETRY") == "1":
         try:
             run_telemetry_ab()

@@ -1296,6 +1296,98 @@ def runtime_check(kp=None, num_shards: int = _CHECK_SHARDS,
 
 
 # ---------------------------------------------------------------------------
+# donation contract (KC008): kstate.DONATION vs kernel.py donate_argnums
+# ---------------------------------------------------------------------------
+
+
+def _donation_decl(tree: ast.Module) -> tuple[dict | None, int]:
+    """The DONATION literal from a kstate-shaped module (+ its line)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "DONATION":
+            try:
+                return ast.literal_eval(node.value), node.lineno
+            except (ValueError, SyntaxError):
+                return None, node.lineno
+    return None, 1
+
+
+def _donated_entries(tree: ast.Module) -> dict[str, tuple[tuple, list, int]]:
+    """kernel.py functions carrying donate_argnums: name ->
+    (argnums, positional param names, lineno)."""
+    out: dict[str, tuple[tuple, list, int]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            for k in dec.keywords:
+                if k.arg != "donate_argnums":
+                    continue
+                try:
+                    nums = ast.literal_eval(k.value)
+                except (ValueError, SyntaxError):
+                    nums = None
+                if isinstance(nums, int):
+                    nums = (nums,)
+                params = [a.arg for a in (node.args.posonlyargs
+                                          + node.args.args)]
+                out[node.name] = (tuple(nums) if nums else (),
+                                  params, node.lineno)
+    return out
+
+
+def donation_check(root: str, kstate_tree: ast.Module,
+                   kernel_tree: ast.Module) -> list[Finding]:
+    """Cross-check the declared donation contract against the kernel's
+    actual ``donate_argnums`` decorations (both directions)."""
+    findings: list[Finding] = []
+    krel = rel(root, os.path.join(root, KERNEL_FILE))
+    srel = rel(root, os.path.join(root, CONTRACT_FILES[0]))
+    decl, decl_line = _donation_decl(kstate_tree)
+    entries = _donated_entries(kernel_tree)
+    if decl is None:
+        if entries:
+            findings.append(Finding(
+                PASS, srel, decl_line, "KC008",
+                "kernel.py donates buffers but kstate.py has no (or a "
+                "non-literal) DONATION declaration"))
+        return findings
+    for name, spec in decl.items():
+        if name not in entries:
+            findings.append(Finding(
+                PASS, srel, decl_line, "KC008",
+                f"DONATION declares {name} but kernel.py has no such "
+                "donate_argnums-decorated function"))
+            continue
+        nums, params, line = entries[name]
+        want_nums = tuple(spec.get("argnums", ()))
+        if nums != want_nums:
+            findings.append(Finding(
+                PASS, krel, line, "KC008",
+                f"{name}: donate_argnums {nums} != declared "
+                f"DONATION argnums {want_nums}"))
+            continue
+        bound = tuple(params[i] for i in nums if i < len(params))
+        want_params = tuple(spec.get("params", ()))
+        if bound != want_params:
+            findings.append(Finding(
+                PASS, krel, line, "KC008",
+                f"{name}: donated parameters {bound} != declared "
+                f"DONATION params {want_params}"))
+    for name, (_, _, line) in entries.items():
+        if name not in decl:
+            findings.append(Finding(
+                PASS, krel, line, "KC008",
+                f"{name} donates buffers but is not declared in "
+                "kstate.DONATION — the host no-touch contract is "
+                "undocumented/unchecked"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # pass entry point
 # ---------------------------------------------------------------------------
 
@@ -1337,4 +1429,8 @@ def run(root: str, files: list[str] | None = None) -> list[Finding]:
 
     if default_mode:
         findings = findings + runtime_check(root=root)
+        ktree = tree_of(os.path.join(root, CONTRACT_FILES[0]))
+        ntree = tree_of(os.path.join(root, KERNEL_FILE))
+        if ktree is not None and ntree is not None:
+            findings = findings + donation_check(root, ktree, ntree)
     return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))

@@ -73,16 +73,20 @@ def _count_ops(hlo_text: str) -> dict[str, int]:
 
 
 def measure(groups: int = 64, replicas: int = 3, iters: int = 20,
-            onehot_reads: bool = True) -> dict[str, int]:
-    """Optimized-HLO op counts for the bench step loop on CPU."""
+            onehot_reads: bool = True,
+            entry: str = "run_steps") -> dict[str, int]:
+    """Optimized-HLO op counts for a bench step loop on CPU.
+
+    ``entry`` selects the traced loop: ``run_steps`` (the serial oracle)
+    or ``run_steps_pipelined`` (PipelineConfig depth 1's fused
+    double-step body) — both must stay inside their budgets so neither
+    loop can quietly regrow per-lane gathers."""
     from dragonboat_tpu import tracing
-    from dragonboat_tpu.bench_loop import (
-        bench_params,
-        make_cluster,
-        run_steps,
-    )
+    from dragonboat_tpu import bench_loop
+    from dragonboat_tpu.bench_loop import bench_params, make_cluster
     from dragonboat_tpu.core.kstate import empty_inbox
 
+    loop = getattr(bench_loop, entry)
     with tracing.annotate("lint.hlo.build"):
         # onehot_reads is keyed off the *target* platform; lowering runs
         # on CPU either way (JAX_PLATFORMS=cpu, set by the runner)
@@ -91,8 +95,8 @@ def measure(groups: int = 64, replicas: int = 3, iters: int = 20,
         state = make_cluster(kp, groups, replicas)
         box = empty_inbox(kp, state.term.shape[0])
     with tracing.annotate("lint.hlo.lower"):
-        lowered = run_steps.lower(kp, replicas, iters, True, True,
-                                  state, box)
+        lowered = loop.lower(kp, replicas, iters, True, True,
+                             state, box)
     with tracing.annotate("lint.hlo.compile"):
         compiled = lowered.compile()
     return _count_ops(compiled.as_text())
@@ -146,7 +150,12 @@ def _cache_store(root: str, key: str, measured: dict[str, int]) -> None:
 
 def run(root: str, budget_path: str | None = None,
         measured: dict[str, int] | None = None) -> list[Finding]:
-    """Gate ``measured`` (or a fresh measurement) against the budget."""
+    """Gate ``measured`` (or a fresh measurement) against the budget.
+
+    Gates BOTH traced loops when the budget file declares them: the
+    serial ``run_steps`` budget lives at the top level (the original
+    schema), the pipelined loop's under ``"pipelined"``.  A flat
+    ``measured`` dict passed by a caller gates the serial entry only."""
     path = budget_path or os.path.join(root, BUDGET_FILE)
     relpath = rel(root, path)
     if not os.path.exists(path):
@@ -155,28 +164,44 @@ def run(root: str, budget_path: str | None = None,
                         "--reseed-hlo-budget to seed it")]
     spec = load_budget(path)
     cfg = spec.get("config", {})
-    if measured is None:
+    sections: dict[str, dict] = {"run_steps": spec.get("budget", {})}
+    if "pipelined" in spec:
+        sections["run_steps_pipelined"] = spec["pipelined"].get("budget", {})
+    if measured is not None:
+        measured_map = {"run_steps": measured}
+    else:
         key = source_hash(root, cfg)
-        measured = _cache_load(root, key)
-        if measured is None:
-            measured = measure(
-                groups=cfg.get("groups", 64),
-                replicas=cfg.get("replicas", 3),
-                iters=cfg.get("iters", 20),
-                onehot_reads=cfg.get("onehot_reads", True))
-            _cache_store(root, key, measured)
+        cached = _cache_load(root, key)
+        if cached is not None and set(sections) <= set(cached):
+            measured_map = cached
+        else:
+            measured_map = {
+                entry: measure(
+                    groups=cfg.get("groups", 64),
+                    replicas=cfg.get("replicas", 3),
+                    iters=cfg.get("iters", 20),
+                    onehot_reads=cfg.get("onehot_reads", True),
+                    entry=entry)
+                for entry in sections
+            }
+            _cache_store(root, key, measured_map)
     findings = []
-    for op in GATED_OPS:
-        key = op.replace("-", "_")
-        limit = spec["budget"].get(key)
-        got = measured.get(key, 0)
-        if limit is not None and got > limit:
-            findings.append(Finding(
-                PASS, relpath, 1, "HB001",
-                f"optimized-HLO `{op}` count {got} exceeds budget {limit} "
-                f"(the kernel regressed toward per-lane {op}s; if the "
-                "change is justified, --reseed-hlo-budget and record why "
-                "in PERF.md)"))
+    for entry, budget in sections.items():
+        got_map = measured_map.get(entry)
+        if got_map is None:
+            continue
+        tag = "" if entry == "run_steps" else f" [{entry}]"
+        for op in GATED_OPS:
+            key = op.replace("-", "_")
+            limit = budget.get(key)
+            got = got_map.get(key, 0)
+            if limit is not None and got > limit:
+                findings.append(Finding(
+                    PASS, relpath, 1, "HB001",
+                    f"optimized-HLO `{op}` count{tag} {got} exceeds budget "
+                    f"{limit} (the kernel regressed toward per-lane {op}s; "
+                    "if the change is justified, --reseed-hlo-budget and "
+                    "record why in PERF.md)"))
     return findings
 
 
@@ -187,6 +212,9 @@ def reseed(root: str, budget_path: str | None = None,
     path = budget_path or os.path.join(root, BUDGET_FILE)
     measured = measure(groups=groups, replicas=replicas, iters=iters,
                        onehot_reads=onehot_reads)
+    measured_pipe = measure(groups=groups, replicas=replicas, iters=iters,
+                            onehot_reads=onehot_reads,
+                            entry="run_steps_pipelined")
     spec = {
         "config": {
             "kernel": "bench_loop.run_steps",
@@ -200,13 +228,24 @@ def reseed(root: str, budget_path: str | None = None,
         "budget": {op.replace("-", "_"): measured[op.replace("-", "_")]
                    for op in GATED_OPS},
         "observed": measured,
-        "note": ("Budgets gate gather/scatter/while; counts are "
-                 "group-count-independent.  Update via scripts/lint.py "
-                 "--reseed-hlo-budget + a PERF.md note justifying the "
-                 "change."),
+        "pipelined": {
+            "kernel": "bench_loop.run_steps_pipelined",
+            "budget": {op.replace("-", "_"):
+                       measured_pipe[op.replace("-", "_")]
+                       for op in GATED_OPS},
+            "observed": measured_pipe,
+        },
+        "note": ("Budgets gate gather/scatter/while over BOTH traced "
+                 "loops (serial run_steps at the top level, the fused "
+                 "depth-1 run_steps_pipelined under 'pipelined'); counts "
+                 "are group-count-independent.  Update via "
+                 "scripts/lint.py --reseed-hlo-budget + a PERF.md note "
+                 "justifying the change."),
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(spec, f, indent=2, sort_keys=True)
         f.write("\n")
-    _cache_store(root, source_hash(root, spec["config"]), measured)
+    _cache_store(root, source_hash(root, spec["config"]),
+                 {"run_steps": measured,
+                  "run_steps_pipelined": measured_pipe})
     return spec

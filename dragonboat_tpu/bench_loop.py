@@ -158,6 +158,79 @@ def run_steps(kp: KP.KernelParams, replicas: int, iters: int,
     return jax.lax.fori_loop(0, iters, body, (state, box))
 
 
+# ---------------------------------------------------------------------------
+# pipelined (double-pumped) loops — PipelineConfig depth 1's device shape.
+#
+# One PIPELINE step fuses two protocol micro-steps (step ∘ route, twice)
+# under a single fori_loop body, so the host boundary — and the
+# instrumentation clock `now` — advances once per fused pair.  Raft's
+# propose → replicate → ack → commit chain spans 2 micro-steps; fused,
+# it retires inside ONE pipeline step, which is exactly the "commit p50
+# ≤ 1 tick" the roadmap targets.  Everything in the carry is i32/bool
+# (threefry included), so fusing the pair is bitwise-neutral:
+# run_steps_pipelined(n) must equal run_steps(2n) leaf-for-leaf — the
+# depth-0 serial loop stays the differential oracle
+# (tests/test_pipeline_differential.py).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def run_steps_pipelined(kp: KP.KernelParams, replicas: int, iters: int,
+                        tick, propose, state: ShardState, box: Inbox):
+    """iters pipeline steps, each two fused self-driving micro-steps —
+    bitwise ≡ ``run_steps(kp, replicas, 2 * iters, ...)``."""
+    tick = jnp.asarray(tick, bool)
+    propose = jnp.asarray(propose, bool)
+
+    def body(_, carry):
+        st, bx = carry
+        st, bx, _ = full_step(kp, replicas, st, bx, tick, propose)
+        st, bx, _ = full_step(kp, replicas, st, bx, tick, propose)
+        return st, bx
+
+    return jax.lax.fori_loop(0, iters, body, (state, box))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def run_steps_storm_pipelined(kp: KP.KernelParams, replicas: int, iters: int,
+                              drop_p, seed, state: ShardState, box: Inbox):
+    """Pipelined election storm: the fold_in counter advances per
+    MICRO-step (2i, 2i+1) so the Bernoulli drop masks replay the serial
+    loop's RNG stream exactly — bitwise ≡ ``run_steps_storm(2 * iters)``."""
+    key0 = jax.random.PRNGKey(seed)
+    drop_p = jnp.asarray(drop_p, jnp.float32)
+
+    def body(i, carry):
+        st, bx = carry
+        st, bx, _ = full_step(kp, replicas, st, bx, True, False)
+        bx = _drop_box(bx, jax.random.fold_in(key0, 2 * i), drop_p)
+        st, bx, _ = full_step(kp, replicas, st, bx, True, False)
+        bx = _drop_box(bx, jax.random.fold_in(key0, 2 * i + 1), drop_p)
+        return st, bx
+
+    return jax.lax.fori_loop(0, iters, body, (state, box))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def run_steps_mixed_pipelined(kp: KP.KernelParams, replicas: int, iters: int,
+                              write_width: int, now0, state: ShardState,
+                              box: Inbox, reads):
+    """Pipelined 9:1 mix: the ReadIndex ctx clock advances per micro-step
+    (now0 + 2i, now0 + 2i + 1) — bitwise ≡ ``run_steps_mixed(2 * iters)``."""
+
+    def body(i, carry):
+        st, bx, rd = carry
+        for j in (0, 1):
+            inp = _self_input(kp, st, True, True, write_width, True,
+                              now0 + 2 * i + j)
+            st, out = step(kp, st, bx, inp)
+            bx = route(kp, replicas, out)
+            rd = rd + out.rtr_valid.sum(dtype=jnp.int32)
+        return st, bx, rd
+
+    return jax.lax.fori_loop(0, iters, body, (state, box, reads))
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def run_steps_mixed(kp: KP.KernelParams, replicas: int, iters: int,
                     write_width: int, now0, state: ShardState, box: Inbox,
@@ -424,6 +497,32 @@ def run_steps_lat(kp: KP.KernelParams, replicas: int, iters: int,
         st, bx, sp, hi, rd = full_step_lat(
             kp, replicas, write_width, do_reads, st, bx,
             tick, propose, now0 + i, sp, hi, rd)
+        return st, bx, sp, hi, rd
+
+    return jax.lax.fori_loop(0, iters, body,
+                             (state, box, stamp, hist, reads))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def run_steps_lat_pipelined(kp: KP.KernelParams, replicas: int, iters: int,
+                            write_width: int, do_reads: bool, tick, propose,
+                            now0, state, box, stamp, hist, reads):
+    """Instrumented pipelined loop: both fused micro-steps stamp and
+    bucket against the SAME pipeline-step clock ``now0 + i`` — the
+    histogram therefore measures commit latency in PIPELINE steps, the
+    unit a client of the overlapped loop actually waits in.  (Deliberately
+    NOT bitwise-comparable to ``run_steps_lat``: the stamp ring differs by
+    construction.  The uninstrumented pipelined loops are the bitwise
+    oracles.)"""
+    tick = jnp.asarray(tick, bool)
+    propose = jnp.asarray(propose, bool)
+
+    def body(i, carry):
+        st, bx, sp, hi, rd = carry
+        for _ in (0, 1):
+            st, bx, sp, hi, rd = full_step_lat(
+                kp, replicas, write_width, do_reads, st, bx,
+                tick, propose, now0 + i, sp, hi, rd)
         return st, bx, sp, hi, rd
 
     return jax.lax.fori_loop(0, iters, body,

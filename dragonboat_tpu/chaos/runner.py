@@ -97,6 +97,11 @@ class ScheduleResult:
 class _Cluster:
     seed: int
     n: int
+    # run shards as lanes of the batched device kernel instead of host
+    # Peers, optionally through the depth-1 software pipeline — chaos
+    # then exercises crash/restart with a donated step in flight
+    device_resident: bool = False
+    pipeline_depth: int = 0
     hosts: dict = field(default_factory=dict)      # rid -> NodeHost
     mems: dict = field(default_factory=dict)       # rid -> MemFS
     fss: dict = field(default_factory=dict)        # rid -> CrashPointFS
@@ -124,6 +129,8 @@ class _Cluster:
             node_host_dir="/data",
             expert=ExpertConfig(
                 fs=self.fss[rid],
+                kernel_log_cap=256, kernel_capacity=4,
+                kernel_pipeline_depth=self.pipeline_depth,
                 logdb=LogDBConfig(shards=1,
                                   recovery_mode="quarantine")))
 
@@ -137,7 +144,8 @@ class _Cluster:
         nh = NodeHost(self._nhconfig(rid))
         cfg = Config(shard_id=self.SHARD, replica_id=rid, election_rtt=10,
                      heartbeat_rtt=1, snapshot_entries=0,
-                     compaction_overhead=5)
+                     compaction_overhead=5,
+                     device_resident=self.device_resident)
         self.cfgs[rid] = cfg
         nh.start_replica(dict(self.addrs), False, ChaosKV, cfg)
         self.hosts[rid] = nh
@@ -355,13 +363,20 @@ class _Cluster:
 def run_schedule(seed: int, plan: FaultPlan | None = None,
                  n_replicas: int = 3, steps: int = 6,
                  proposals_per_step: int = 4,
-                 converge_timeout: float = 30.0) -> ScheduleResult:
+                 converge_timeout: float = 30.0,
+                 device_resident: bool = False,
+                 pipeline_depth: int = 0) -> ScheduleResult:
     """Execute one composed fault schedule; returns the recorded trace
     (canonical JSON) and the oracle report.  Pass ``plan`` to replay a
-    recorded trace (``FaultPlan.from_json``) instead of generating."""
+    recorded trace (``FaultPlan.from_json``) instead of generating.
+    ``device_resident=True`` runs the shards on the batched kernel
+    engine, ``pipeline_depth=1`` additionally through the overlapped
+    donating step loop — so faults land while a step is in flight."""
     if plan is None:
         plan = FaultPlan.generate(seed, n_replicas=n_replicas, steps=steps)
-    cluster = _Cluster(seed=seed, n=plan.n_replicas)
+    cluster = _Cluster(seed=seed, n=plan.n_replicas,
+                       device_resident=device_resident,
+                       pipeline_depth=pipeline_depth)
     executed: list = []
     acked: list = []
     applied_samples: dict = {}

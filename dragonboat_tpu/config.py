@@ -155,6 +155,11 @@ class ExpertConfig:
     # fleet_stats reduction (core/fleet.py) every N steps and fetch one
     # small struct to host; 0 disables the reduction entirely
     fleet_stats_every: int = 10
+    # engine software-pipeline depth (engine/kernel_engine.py): 0 runs
+    # the serial stage->dispatch->fetch->process loop (the differential
+    # oracle); 1 overlaps host staging/output-retirement with the device
+    # step, dispatching through the donating jit entry
+    kernel_pipeline_depth: int = 0
 
 
 @dataclass

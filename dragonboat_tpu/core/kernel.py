@@ -1197,3 +1197,48 @@ def step(kp: P.KernelParams, state: ShardState, inbox: Inbox,
          inp: StepInput) -> tuple[ShardState, StepOutput]:
     """vmap the per-shard step across the [G] axis and jit the result."""
     return jax.vmap(functools.partial(_shard_step, kp))(state, inbox, inp)
+
+
+# Donated entry point for the pipelined engine loop: identical math to
+# ``step``, but XLA may reuse the state/inbox/input buffers for the
+# outputs instead of allocating fresh SoA arrays every step.  The host
+# contract this implies is declared in kstate.DONATION and cross-checked
+# by analysis/contracts.py (KC008): after a step_donated dispatch the
+# caller must treat the donated arrays as dead — every host read goes
+# through the RETURNED state or the host mirrors, never the arguments.
+# Backends without donation support (CPU) fall back to copying; the
+# engine keeps the same no-touch discipline on all backends so the
+# differential oracle covers the strict contract.
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2, 3))
+def step_donated(kp: P.KernelParams, state: ShardState, inbox: Inbox,
+                 inp: StepInput) -> tuple[ShardState, StepOutput]:
+    """``step`` with state/inbox/input buffers donated to XLA."""
+    return jax.vmap(functools.partial(_shard_step, kp))(state, inbox, inp)
+
+
+# Message-class order of the [G, C] activity-flag matrix produced by
+# ``output_row_flags`` — the engine's masked output fetch keys on these
+# columns to decide which wide StepOutput fields to materialize at all.
+FLAG_CLASSES = ("resp", "rep", "hb", "vote", "timeout_now",
+                "need_snapshot", "wit_snap", "rtr")
+
+
+@jax.jit
+def output_row_flags(outs) -> jnp.ndarray:
+    """[G, C] bool: per-row any() over each message class of a StepOutput.
+
+    One tiny device reduction replaces the host-side per-field
+    ``np.asarray(...).any(axis=1)`` sweep that previously forced every
+    wide [G, K]/[G, P]/[G, RI] output field across the device boundary
+    every step.  Column order is ``FLAG_CLASSES``."""
+    cols = (
+        jnp.any(outs.r_type != 0, axis=1),
+        jnp.any(outs.s_rep, axis=1),
+        jnp.any(outs.s_hb, axis=1),
+        jnp.any(outs.s_vote != 0, axis=1),
+        jnp.any(outs.s_timeout_now, axis=1),
+        jnp.any(outs.s_need_snapshot, axis=1),
+        jnp.any(outs.s_wit_snap, axis=1),
+        jnp.any(outs.rtr_valid, axis=1),
+    )
+    return jnp.stack(cols, axis=1)

@@ -177,6 +177,33 @@ CONTRACTS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Buffer-donation contract (checked by analysis/contracts.py, KC008).
+#
+# Each entry names a jitted entry point in core/kernel.py that donates
+# argument buffers to XLA and records WHICH positional arguments (and the
+# parameter names they bind) are donated.  The analyzer parses kernel.py's
+# decorators and fails lint if the ``donate_argnums`` there drifts from
+# this declaration — so the host-side rule below is always describing the
+# real kernel, not a stale comment.
+#
+# Host rule implied by donation: after dispatching a donated entry point
+# the caller MUST NOT read or re-pass the donated argument arrays — XLA
+# may have reused their memory for the outputs.  All host reads go
+# through the returned state/output (or host mirrors); the engine's
+# builders re-materialize fresh inbox/input device arrays every step.
+# Backends that cannot donate (CPU) silently copy instead; the engine
+# keeps the same discipline regardless so behavior is backend-uniform.
+# ---------------------------------------------------------------------------
+
+DONATION = {
+    "step_donated": {
+        "argnums": (1, 2, 3),
+        "params": ("state", "inbox", "inp"),
+    },
+}
+
+
 class ShardState(NamedTuple):
     """Per-shard raft state; every field has a leading [G] axis (or [G, ...])."""
 

@@ -27,12 +27,25 @@ ReadIndex across hosts: a follower-host read forwards a READ_INDEX
 message to the leader host (raft.go:1296 leader-forwarding), the leader
 feeds it to its kernel lane as a batched-read ctx and answers with
 READ_INDEX_RESP — the kernel itself only ever sees leader-local reads.
+
+Pipelining (``pipeline_depth``): at depth 0 each ``step_all`` runs the
+serial loop — stage, dispatch, fetch, process — and is the differential
+oracle.  At depth 1 the loop is software-pipelined: staging for step N
+builds into the ALTERNATE half of a double-buffered inbox/input pair
+while the device still executes step N-1; step N-1's outputs are then
+retired (the async fetch is consumed one step late) BEFORE step N is
+dispatched through the donating jit entry (core/kernel.py
+``step_donated``) — the retire-before-dispatch order is the donation
+contract: dispatch hands the state/inbox/input buffers to XLA, so
+every read of the previous state (lt rows for the update batch, the
+wit-snap compaction floor) must complete first, and the host never
+touches a buffer again after its dispatch.
 """
 
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, replace as _dc_replace
+from dataclasses import dataclass, field, replace as _dc_replace
 
 import jax.numpy as jnp
 import numpy as np
@@ -41,7 +54,12 @@ from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.tracing import annotate
 from dragonboat_tpu.config import Config
 from dragonboat_tpu.core import params as KP
-from dragonboat_tpu.core.kernel import step as kernel_step
+from dragonboat_tpu.core.kernel import (
+    FLAG_CLASSES,
+    output_row_flags,
+    step as kernel_step,
+    step_donated as kernel_step_donated,
+)
 from dragonboat_tpu.core.kstate import (
     Inbox,
     ShardState,
@@ -67,6 +85,49 @@ _KERNEL_MTYPES = frozenset({
     MT.REQUEST_PREVOTE_RESP, MT.TIMEOUT_NOW, MT.UNREACHABLE,
     MT.SNAPSHOT_STATUS,
 })
+
+# column per message class in the [G, C] output_row_flags matrix
+# (core/kernel.py FLAG_CLASSES order)
+_F = {c: i for i, c in enumerate(FLAG_CLASSES)}
+_F_RESP, _F_REP, _F_HB, _F_VOTE = _F["resp"], _F["rep"], _F["hb"], _F["vote"]
+_F_TIMEOUT, _F_WITSNAP, _F_RTR = _F["timeout_now"], _F["wit_snap"], _F["rtr"]
+
+
+class _LazyOut:
+    """Field-lazy host view of a ``StepOutput``: ``np.asarray`` per field
+    on FIRST access only.  The eager 42-field fetch blocked the host on
+    the whole async device step even when the activity mask would prove
+    most fields dead (PERF.md's ~80%%-of-wall-clock stall); lanes with no
+    replicates never pay for ``s_ent_term`` and friends."""
+
+    __slots__ = ("_out", "_np")
+
+    def __init__(self, out) -> None:
+        self._out = out
+        self._np: dict[str, np.ndarray] = {}
+
+    def __getitem__(self, f: str) -> np.ndarray:
+        v = self._np.get(f)
+        if v is None:
+            v = np.asarray(getattr(self._out, f))
+            self._np[f] = v
+        return v
+
+
+@dataclass
+class _StepCtx:
+    """Everything the deferred output pass of ONE dispatched step needs,
+    captured at dispatch time: staging for the NEXT step rebinds
+    ``n._staged_props`` / ``n._staged_ri`` before a pipelined step's
+    outputs are retired, so fates and read ctxs must ride the ctx, not
+    the node."""
+
+    nodes: dict[int, "KernelNode"]
+    fates: dict[int, list]                  # row -> [(entry, origin), ...]
+    staged_ri: dict[int, pb.SystemCtx]      # row -> staged ReadIndex ctx
+    staged_rows: set[int]
+    out: object = None                      # device StepOutput (async)
+    dead: set[int] = field(default_factory=set)   # rows removed in flight
 
 
 class KernelNode(Node):
@@ -245,7 +306,8 @@ class KernelEngine:
     def __init__(self, kp: KP.KernelParams, capacity: int,
                  send_message, events: EventHub | None = None,
                  election_rtt: int = 10, heartbeat_rtt: int = 1,
-                 fleet_stats_every: int = 10) -> None:
+                 fleet_stats_every: int = 10,
+                 pipeline_depth: int = 0) -> None:
         self.kp = kp
         self.capacity = capacity
         self.send_message = send_message
@@ -289,10 +351,11 @@ class KernelEngine:
         # first-call guard for the cross-engine compile serialization in
         # step_all (the class-wide _first_compile_mu)
         self._compiled_once = False
-        # host mirror of the device peer-kind book: kinds only change on
-        # injection/membership updates, so the output path must not pay a
-        # device->host transfer for them every step
+        # host mirrors of the device peer books: pids/kinds only change
+        # on injection/membership updates, so the output path must not
+        # pay a device->host transfer for them every step
         self._kind_np = np.zeros((capacity, kp.num_peers), np.int32)
+        self._pid_np = np.zeros((capacity, kp.num_peers), np.int32)
         # admissions queued for the next step's batched injection
         # (lane -> (node, init, pids, kinds)); see _flush_injections
         self._pending_inject: dict[int, tuple] = {}
@@ -305,16 +368,36 @@ class KernelEngine:
         self._tick_mu = threading.Lock()
         # persistent staging buffers, zeroed per step (the jitted step
         # needs fixed [capacity] shapes anyway; reallocating every engine
-        # iteration would cost ~G*K*E ints of fresh numpy per step)
-        self._inbox_buf = _InboxBuilder(capacity, kp.inbox_cap,
-                                        kp.msg_entries)
-        self._input_buf = _InputBuilder(capacity, kp.proposal_cap)
+        # iteration would cost ~G*K*E ints of fresh numpy per step).
+        # TWO pairs: at pipeline depth 1 staging for step N writes the
+        # alternate pair while step N-1 (whose device inbox may alias
+        # its numpy staging on CPU backends, and whose buffers are
+        # donated) is still in flight; a pair is only rewritten after
+        # the step that used it has retired
+        self._bufs = tuple(
+            (_InboxBuilder(capacity, kp.inbox_cap, kp.msg_entries),
+             _InputBuilder(capacity, kp.proposal_cap))
+            for _ in range(2))
+        self._buf_idx = 0
+        # aliases to the pair of the most recent dispatch (fleet stats
+        # and tests read the staged inbox through these)
+        self._inbox_buf, self._input_buf = self._bufs[0]
+        # software pipeline: 0 = serial oracle (stage, dispatch, fetch,
+        # process in one pass), 1 = retire step N-1 while N is staged,
+        # dispatching N through the donating jit entry
+        self.pipeline_depth = max(0, min(1, int(pipeline_depth)))
+        self._pending_ctx: _StepCtx | None = None
+        # pipeline occupancy accounting: a dispatch is "overlapped" when
+        # a previous step was still unretired at its staging
+        self._pipe_steps = 0
+        self._pipe_overlapped = 0
         # step-latency accounting + opt-in jax.profiler capture
         from dragonboat_tpu.tracing import StepTimer, maybe_start_from_env
 
         self._step_timer = StepTimer(self.events.metrics,
                                      "engine.kernel_step")
         maybe_start_from_env()
+        self.events.metrics.set("engine.pipeline.depth", self.pipeline_depth)
         # decimated device-side fleet telemetry (core/fleet.py): every N
         # steps one jitted reduction over the resident state fetches ONE
         # small struct to host; 0 disables
@@ -370,6 +453,7 @@ class KernelEngine:
         for i, (rid, kind) in enumerate(init.peers[:kp.num_peers]):
             pids[i], kinds[i] = rid, kind
         self._kind_np[lane] = kinds
+        self._pid_np[lane] = pids
         for e in init.entries:
             node.mirror[e.index] = e
         self._triple_np[lane] = (init.term, init.vote, init.committed)
@@ -498,6 +582,7 @@ class KernelEngine:
             # evicted before its injection ever flushed: the lane state
             # was never written, so there is nothing to clear on device
             self._kind_np[lane] = KP.K_ABSENT
+            self._pid_np[lane] = 0
             self._triple_np[lane] = -1
             self._occ_np[lane] = False
             return
@@ -508,6 +593,7 @@ class KernelEngine:
             needs_host=s.needs_host.at[lane].set(False),
         )
         self._kind_np[lane] = KP.K_ABSENT
+        self._pid_np[lane] = 0
         self._triple_np[lane] = -1
         self._occ_np[lane] = False
 
@@ -551,6 +637,7 @@ class KernelEngine:
             pending_cc=s.pending_cc.at[g].set(False),
         )
         self._kind_np[g] = kinds
+        self._pid_np[g] = pids
 
     # -- the step ---------------------------------------------------------
 
@@ -573,18 +660,40 @@ class KernelEngine:
 
     def step_all(self) -> bool:
         """One engine iteration; returns True if any lane had work
-        (messages, ticks, proposals, reads).  Only DIRTY lanes stage —
-        the full-scan form cost 16 µs/lane of Python per step (1.6 s at
-        100k lanes) whether or not anything was pending.  Runs under the
-        engine lock: lane injection/eviction and the device state update
-        must not interleave with a step."""
+        (messages, ticks, proposals, reads) or an in-flight pipelined
+        step was retired.  Only DIRTY lanes stage — the full-scan form
+        cost 16 µs/lane of Python per step (1.6 s at 100k lanes) whether
+        or not anything was pending.  Runs under the engine lock: lane
+        injection/eviction and the device state update must not
+        interleave with a step.
+
+        Pipeline order at depth 1 (every part of it is load-bearing):
+        (1) stage step N into the alternate buffer pair — host marshaling
+        overlaps the device compute of step N-1; (2) retire step N-1's
+        deferred outputs — this is the first point the host blocks on
+        the device, and it must run BEFORE (3) dispatches step N with
+        donated buffers, because retiring reads previous-state leaves
+        (lt rows, the wit-snap floor) that donation hands to XLA."""
         with self.mu:
             nodes = dict(self.nodes)
             if not nodes:
+                if self._pending_ctx is not None:
+                    # every lane vanished with a step in flight: fail the
+                    # removed nodes' staged futures, then retire the step
+                    # so nothing hangs on an answer that cannot land
+                    removed, self._removed_nodes = self._removed_nodes, []
+                    for n in removed:
+                        if not self._is_registered(n):
+                            self._scrub_pending_ctx(n)
+                            self._drop_staged_fates(n)
+                    ctx, self._pending_ctx = self._pending_ctx, None
+                    with annotate("kernel_engine.process_outputs"):
+                        self._process_outputs(ctx)
+                    return True
                 return False
             self._flush_injections()
-            inbox = self._inbox_buf
-            inp = self._input_buf
+            inbox, inp = self._bufs[self._buf_idx]
+            self._inbox_buf, self._input_buf = inbox, inp
             inbox.reset()
             inp.reset()
             had_work = False
@@ -596,7 +705,9 @@ class KernelEngine:
             staged = [(g, nodes[g]) for g in sorted(dirty) if g in nodes]
             # staging may target OTHER rows' prop slots (mesh engines
             # forward follower-host proposals to the leader row); only
-            # rows recorded as prop targets can hold stale fates
+            # rows recorded as prop targets can hold stale fates.  The
+            # pending ctx (if any) captured the OLD list objects, so the
+            # rebind here cannot lose in-flight fates
             self._slot_cursor: dict[int, int] = {}
             for g in self._staged_rows:
                 n = nodes.get(g)
@@ -623,18 +734,45 @@ class KernelEngine:
             # origin futures fail fast instead of timing out.  Removals
             # are drained from the explicit log remove_shard keeps (the
             # full [capacity] registration sweep this replaces was a fixed
-            # ~16 µs/lane of Python per step at 100k lanes)
+            # ~16 µs/lane of Python per step at 100k lanes).  An in-flight
+            # pipelined step is scrubbed FIRST: its captured fates are the
+            # removed node's un-reset lists, and the scrub empties them so
+            # _drop_staged_fates cannot fail the same futures twice
             removed, self._removed_nodes = self._removed_nodes, []
             for n in removed:
                 if self._is_registered(n):
                     continue  # re-admitted since removal
+                self._scrub_pending_ctx(n)
                 self._drop_staged_fates(n)
                 if nodes.get(n.lane) is n:
                     nodes.pop(n.lane)
             if not (had_work or self._device_pending()):
+                if self._pending_ctx is not None:
+                    # nothing new to dispatch — drain the pipeline: the
+                    # in-flight step's outputs still owe applies, futures
+                    # and events, and retiring re-dirties its lanes so
+                    # follow-on work stages next iteration
+                    ctx, self._pending_ctx = self._pending_ctx, None
+                    with annotate("kernel_engine.process_outputs"):
+                        self._process_outputs(ctx)
+                    return True
                 return False
 
+            ctx = _StepCtx(
+                nodes=nodes,
+                fates={g: nodes[g]._staged_props
+                       for g in self._staged_rows if g in nodes},
+                staged_ri={g: n._staged_ri for g, n in staged
+                           if n._staged_ri is not None},
+                staged_rows=set(self._staged_rows),
+            )
             with self._step_timer.measure():
+                overlapped = self._pending_ctx is not None
+                if overlapped:
+                    # retire step N-1 BEFORE the donating dispatch of N
+                    pending, self._pending_ctx = self._pending_ctx, None
+                    with annotate("kernel_engine.process_outputs"):
+                        self._process_outputs(pending)
                 with annotate("kernel_engine.step"):
                     if not self._compiled_once:
                         # serialize FIRST calls across engines (incl. the
@@ -647,9 +785,26 @@ class KernelEngine:
                         self._compiled_once = True
                     else:
                         state, out = self._kernel_call(inbox, inp)
-                with annotate("kernel_engine.process_outputs"):
-                    self.state = state
-                    self._process_outputs(nodes, out)
+                self.state = state
+                ctx.out = out
+                self._pipe_steps += 1
+                if self.pipeline_depth > 0:
+                    # defer the fetch: the outputs are consumed one step
+                    # late, overlapping device step N+1 with this retire
+                    self._pending_ctx = ctx
+                    self._buf_idx ^= 1
+                    if overlapped:
+                        self._pipe_overlapped += 1
+                    m = self.events.metrics
+                    m.inc("engine.pipeline.steps")
+                    if overlapped:
+                        m.inc("engine.pipeline.overlapped")
+                    m.set("engine.pipeline.occupancy_pct",
+                          100 * self._pipe_overlapped
+                          // max(1, self._pipe_steps))
+                else:
+                    with annotate("kernel_engine.process_outputs"):
+                        self._process_outputs(ctx)
             if self.fleet_stats_every > 0:
                 self._fleet_countdown -= 1
                 if self._fleet_countdown <= 0:
@@ -658,17 +813,40 @@ class KernelEngine:
             return True
 
     def _is_registered(self, n: KernelNode) -> bool:
-        return n.shard_id in self.by_shard
+        # identity, not membership: with a deferred (pipelined) output
+        # pass the same shard id can be re-admitted as a NEW node while
+        # the old one's step is still in flight
+        return self.by_shard.get(n.shard_id) is n
 
-    def _drop_staged_fates(self, n: KernelNode) -> None:
-        for entry, origin in n._staged_props:
+    @staticmethod
+    def _fail_fates(fates) -> None:
+        for entry, origin in fates:
             if entry.is_config_change():
                 origin.pending_config_change.done(
                     entry.key, RequestResultCode.DROPPED)
             else:
                 origin._rl_release(entry.key)
                 origin.pending_proposals.dropped(entry.key)
+
+    def _drop_staged_fates(self, n: KernelNode) -> None:
+        self._fail_fates(n._staged_props)
         n._staged_props = []
+
+    def _scrub_pending_ctx(self, n: KernelNode) -> None:
+        """Remove a dead node's rows from the in-flight step ctx: fail
+        its staged-proposal futures now (the retire pass will skip the
+        row) rather than letting them time out against a node whose
+        books no longer exist."""
+        ctx = self._pending_ctx
+        if ctx is None or ctx.nodes.get(n.lane) is not n:
+            return
+        fates = ctx.fates.pop(n.lane, None)
+        if fates:
+            if n._staged_props is fates:
+                n._staged_props = []
+            self._fail_fates(fates)
+        ctx.staged_ri.pop(n.lane, None)
+        ctx.dead.add(n.lane)
 
     def _device_pending(self) -> bool:
         """Mesh engines carry a device-resident inbox between steps; the
@@ -691,6 +869,14 @@ class KernelEngine:
         self.last_fleet = _fleet.stats_to_dict(stats)
 
     def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
+        if self.pipeline_depth > 0:
+            # donating entry (core/kernel.py step_donated): XLA reuses
+            # the state/inbox/input buffers in place of per-step fresh
+            # allocations.  After this call the host must not read the
+            # passed-in state again — step_all's retire-before-dispatch
+            # order upholds that
+            return kernel_step_donated(self.kp, self.state,
+                                       inbox.to_device(), inp.to_device())
         return kernel_step(self.kp, self.state, inbox.to_device(),
                            inp.to_device())
 
@@ -858,21 +1044,22 @@ class KernelEngine:
 
     # -- output processing -------------------------------------------------
 
-    def _process_outputs(self, nodes: dict[int, KernelNode], out) -> None:
-        kp = self.kp
-        o = {f: np.asarray(getattr(out, f)) for f in (
-            "r_type", "r_to", "r_term", "r_log_index", "r_reject", "r_hint",
-            "r_hint_high", "s_rep", "s_prev_index", "s_prev_term", "s_commit",
-            "s_n_ent", "s_ent_term", "s_vote", "s_vote_term", "s_vote_lindex",
-            "s_vote_lterm", "s_vote_hint", "s_hb", "s_hb_commit", "s_hb_low",
-            "s_hb_high", "s_timeout_now", "s_need_snapshot", "s_wit_snap",
-            "save_first",
-            "save_last", "apply_first", "apply_last", "term", "vote",
-            "commit", "rtr_valid", "rtr_index", "rtr_low", "rtr_high",
-            "ri_dropped", "prop_accepted", "prop_index", "prop_term",
-            "leader", "leader_term", "needs_host",
-        )}
-        pid = np.asarray(self.state.pid)
+    def _process_outputs(self, ctx: _StepCtx) -> None:
+        """Retire one dispatched step: resolve proposal fates, emit
+        messages, persist, apply, complete reads, fire events.  Serial
+        mode calls this inline; pipelined mode one step late (the ctx
+        carries the fates/read ctxs that staging has since rebound).
+
+        The fetch is MASKED: a [G, C] per-class activity matrix (one
+        tiny jitted reduction, core/kernel.py output_row_flags) plus the
+        cheap [G] scalars decide which lanes and which message classes
+        are live, and only those fields are pulled to host (_LazyOut) —
+        the eager 42-field np.asarray sweep was ~80% of step wall clock
+        at 20k lanes."""
+        nodes, out = ctx.nodes, ctx.out
+        flags = np.asarray(output_row_flags(out))
+        o = _LazyOut(out)
+        pid = self._pid_np
         kind = self._kind_np
         # shards whose witness peer needs a snapshot but have no recorded
         # snapshot to strip — they take the regular eviction slow path
@@ -884,24 +1071,16 @@ class KernelEngine:
         # lanes with anything to process, found VECTORIZED — per-lane
         # Python here was 16 us/lane/step at 100k lanes.  The mask must
         # cover every consumer below: emitted messages and snapshot
-        # needs (_emit_messages), save/apply windows and quiet
+        # needs (all eight flag columns), save/apply windows and quiet
         # term/vote/commit changes (_build_update persists a bump even
-        # when no message went out), rtr lanes + dropped reads
-        # (_complete_reads), leader-cache deltas (_leader_edge), staged
-        # proposal fates, and escalation flags.
+        # when no message went out), dropped reads (_complete_reads),
+        # leader-cache deltas (_leader_edge), and escalation flags;
+        # staged proposal fates ride ctx.staged_rows below.
         active = (
-            (o["r_type"] != 0).any(1)
-            | o["s_rep"].any(1)
-            | o["s_hb"].any(1)
-            | (o["s_vote"] != 0).any(1)
-            | o["s_timeout_now"].any(1)
-            | o["s_need_snapshot"].any(1)
-            | o["s_wit_snap"].any(1)
+            flags.any(1)
             | (o["save_last"] >= o["save_first"])
             | (o["apply_last"] >= o["apply_first"])
-            | o["rtr_valid"].any(1)
             | o["ri_dropped"]
-            | o["prop_accepted"].any(1)
             | o["needs_host"]
             | (o["term"] != self._triple_np[:, 0])
             | (o["vote"] != self._triple_np[:, 1])
@@ -910,8 +1089,13 @@ class KernelEngine:
             | (o["leader_term"] != self._lead_term_np)
         ) & self._occ_np
         cand_ids = set(np.nonzero(active)[0].tolist())
-        cand_ids.update(self._staged_rows)
-        cand = [(g, nodes[g]) for g in sorted(cand_ids) if g in nodes]
+        cand_ids.update(ctx.staged_rows)
+        cand_ids.difference_update(ctx.dead)
+        # identity check, not membership: a row whose node was removed
+        # (and possibly re-admitted) while the step was in flight must
+        # not have stale outputs applied to the successor's books
+        cand = [(g, nodes[g]) for g in sorted(cand_ids)
+                if g in nodes and self.nodes.get(g) is nodes[g]]
         # every processed lane re-stages once next step: multi-window
         # pipelines (apply batches, read books, ring compaction) advance
         # by re-examination, exactly as the full scan did
@@ -928,22 +1112,29 @@ class KernelEngine:
         for g, n in cand:
             # 1. proposal fates (origin holds the future's books — on a
             # mesh engine forwarded proposals stage on the leader row)
-            for slot, (entry, origin) in enumerate(n._staged_props):
-                if o["prop_accepted"][g, slot]:
-                    index = int(o["prop_index"][g, slot])
-                    term = int(o["prop_term"][g, slot])
-                    n.mirror[index] = _dc_replace(entry, index=index, term=term)
-                else:
-                    if entry.is_config_change():
-                        origin.pending_config_change.done(
-                            entry.key, RequestResultCode.DROPPED)
+            fates = ctx.fates.get(g)
+            if fates:
+                for slot, (entry, origin) in enumerate(fates):
+                    if o["prop_accepted"][g, slot]:
+                        index = int(o["prop_index"][g, slot])
+                        term = int(o["prop_term"][g, slot])
+                        n.mirror[index] = _dc_replace(
+                            entry, index=index, term=term)
                     else:
-                        origin._rl_release(entry.key)
-                        origin.pending_proposals.dropped(entry.key)
-            n._staged_props = []
+                        if entry.is_config_change():
+                            origin.pending_config_change.done(
+                                entry.key, RequestResultCode.DROPPED)
+                        else:
+                            origin._rl_release(entry.key)
+                            origin.pending_proposals.dropped(entry.key)
+            if fates is not None and n._staged_props is fates:
+                # serial mode retires before the next staging rebinds
+                # the list; pipelined mode's rebind already happened
+                n._staged_props = []
 
-            # 2. outgoing messages
-            self._emit_messages(g, n, o, pid, kind, replicates, others)
+            # 2. outgoing messages, gated per class on the flag row
+            self._emit_messages(g, n, o, flags[g], pid, kind,
+                                replicates, others)
 
             # 3. persistence batch
             ud = self._build_update(g, n, o, lt_rows.get(g))
@@ -972,7 +1163,7 @@ class KernelEngine:
                 continue
             n._committed_cache = int(o["commit"][g])
             # 4. ReadIndex results
-            self._complete_reads(g, n, o)
+            self._complete_reads(g, n, o, flags[g], ctx.staged_ri.get(g))
             # 5. apply released entries
             self._apply(g, n, o)
             # 6. leader edges
@@ -986,30 +1177,40 @@ class KernelEngine:
             elif n.shard_id in self._wit_snap_fallback:
                 self._evict(n, reason="witness snapshot without record")
 
-    def _emit_messages(self, g, n, o, pid, kind, replicates, others) -> None:
+    def _emit_messages(self, g, n, o, fl, pid, kind,
+                       replicates, others) -> None:
+        """Build this row's outgoing messages.  ``fl`` is the row of the
+        [G, C] class-activity matrix: a class whose bit is clear is
+        never indexed, so its wide output field is never fetched."""
         E = self.kp.msg_entries
         shard = n.shard_id
         # response lanes
-        for k in range(o["r_type"].shape[1]):
-            rt = int(o["r_type"][g, k])
-            if rt == 0:
-                continue
-            others.append((n, pb.Message(
-                type=pb.MessageType(rt), to=int(o["r_to"][g, k]),
-                from_=n.replica_id, shard_id=shard,
-                term=int(o["r_term"][g, k]),
-                log_index=int(o["r_log_index"][g, k]),
-                reject=bool(o["r_reject"][g, k]),
-                hint=int(o["r_hint"][g, k]),
-                hint_high=int(o["r_hint_high"][g, k]),
-            )))
+        if fl[_F_RESP]:
+            for k in range(o["r_type"].shape[1]):
+                rt = int(o["r_type"][g, k])
+                if rt == 0:
+                    continue
+                others.append((n, pb.Message(
+                    type=pb.MessageType(rt), to=int(o["r_to"][g, k]),
+                    from_=n.replica_id, shard_id=shard,
+                    term=int(o["r_term"][g, k]),
+                    log_index=int(o["r_log_index"][g, k]),
+                    reject=bool(o["r_reject"][g, k]),
+                    hint=int(o["r_hint"][g, k]),
+                    hint_high=int(o["r_hint_high"][g, k]),
+                )))
+        rep, hb = bool(fl[_F_REP]), bool(fl[_F_HB])
+        vote, tnow = bool(fl[_F_VOTE]), bool(fl[_F_TIMEOUT])
+        wsnap = bool(fl[_F_WITSNAP])
+        if not (rep or hb or vote or tnow or wsnap):
+            return
         # per-peer lanes
         for p in range(pid.shape[1]):
             to = int(pid[g, p])
             if to == 0 or to == n.replica_id:
                 continue
             to_witness = int(kind[g, p]) == KP.K_WITNESS
-            if o["s_rep"][g, p]:
+            if rep and o["s_rep"][g, p]:
                 prev = int(o["s_prev_index"][g, p])
                 cnt = int(o["s_n_ent"][g, p])
                 ents = []
@@ -1034,7 +1235,7 @@ class KernelEngine:
                     commit=int(o["s_commit"][g, p]),
                     entries=tuple(ents),
                 )))
-            if o["s_wit_snap"][g, p]:
+            if wsnap and o["s_wit_snap"][g, p]:
                 # witness peer fell behind compaction: answer with the
                 # stripped file-less snapshot built from the recorded
                 # snapshot (raft.go:713-735) — no stream, no eviction.
@@ -1058,7 +1259,7 @@ class KernelEngine:
                     # no record, or one below the device floor — the
                     # regular escalation path recovers the shard
                     self._wit_snap_fallback.add(n.shard_id)
-            if o["s_hb"][g, p]:
+            if hb and o["s_hb"][g, p]:
                 others.append((n, pb.Message(
                     type=MT.HEARTBEAT, to=to, from_=n.replica_id,
                     shard_id=shard, term=int(o["term"][g]),
@@ -1066,7 +1267,7 @@ class KernelEngine:
                     hint=int(o["s_hb_low"][g, p]),
                     hint_high=int(o["s_hb_high"][g, p]),
                 )))
-            sv = int(o["s_vote"][g, p])
+            sv = int(o["s_vote"][g, p]) if vote else 0
             if sv:
                 others.append((n, pb.Message(
                     type=(MT.REQUEST_VOTE if sv == 1
@@ -1077,7 +1278,7 @@ class KernelEngine:
                     log_term=int(o["s_vote_lterm"][g, p]),
                     hint=int(o["s_vote_hint"][g, p]),
                 )))
-            if o["s_timeout_now"][g, p]:
+            if tnow and o["s_timeout_now"][g, p]:
                 others.append((n, pb.Message(
                     type=MT.TIMEOUT_NOW, to=to, from_=n.replica_id,
                     shard_id=shard, term=int(o["term"][g]))))
@@ -1106,30 +1307,34 @@ class KernelEngine:
             entries_to_save=tuple(entries),
         )
 
-    def _complete_reads(self, g, n, o) -> None:
-        rtr = o["rtr_valid"][g]
-        for j in range(rtr.shape[0]):
-            if not rtr[j]:
-                continue
-            low = int(o["rtr_low"][g, j])
-            high = int(o["rtr_high"][g, j])
-            index = int(o["rtr_index"][g, j])
-            ctx = pb.SystemCtx(low=low, high=high)
+    def _complete_reads(self, g, n, o, fl, staged_ri) -> None:
+        """``staged_ri`` is the ReadIndex ctx staged into THIS step (from
+        the step ctx — staging for the next step rebinds ``n._staged_ri``
+        before a pipelined retire runs)."""
+        if fl[_F_RTR]:
+            rtr = o["rtr_valid"][g]
+            for j in range(rtr.shape[0]):
+                if not rtr[j]:
+                    continue
+                low = int(o["rtr_low"][g, j])
+                high = int(o["rtr_high"][g, j])
+                index = int(o["rtr_index"][g, j])
+                ctx = pb.SystemCtx(low=low, high=high)
+                if low in n._local_ri_pending:
+                    n._local_ri_pending.pop(low)
+                    n.pending_reads.add_ready(ctx, index)
+                elif low in n._remote_ri_inflight:
+                    # remote read answered: respond to the requester
+                    self._send(n, pb.Message(
+                        type=MT.READ_INDEX_RESP,
+                        to=n._remote_ri_inflight.pop(low),
+                        from_=n.replica_id, shard_id=n.shard_id,
+                        log_index=index, hint=low, hint_high=high))
+        if o["ri_dropped"][g] and staged_ri is not None:
+            low = staged_ri.low
             if low in n._local_ri_pending:
                 n._local_ri_pending.pop(low)
-                n.pending_reads.add_ready(ctx, index)
-            elif low in n._remote_ri_inflight:
-                # remote read answered: respond to the requesting replica
-                self._send(n, pb.Message(
-                    type=MT.READ_INDEX_RESP,
-                    to=n._remote_ri_inflight.pop(low),
-                    from_=n.replica_id, shard_id=n.shard_id,
-                    log_index=index, hint=low, hint_high=high))
-        if o["ri_dropped"][g] and n._staged_ri is not None:
-            low = n._staged_ri.low
-            if low in n._local_ri_pending:
-                n._local_ri_pending.pop(low)
-                n.pending_reads.dropped(n._staged_ri)
+                n.pending_reads.dropped(staged_ri)
             n._remote_ri_inflight.pop(low, None)
         n.pending_reads.applied(n.sm.get_last_applied())
 

@@ -45,6 +45,7 @@ from dragonboat_tpu.core.kstate import empty_inbox, init_state
 from dragonboat_tpu.engine.kernel_engine import (
     KernelEngine,
     KernelNode,
+    _F_WITSNAP,
     _LaneInit,
 )
 from dragonboat_tpu.logger import get_logger
@@ -64,7 +65,8 @@ class MeshEngine(KernelEngine):
     device ``(ig, ir)`` the rows of its replica slot."""
 
     def __init__(self, kp: KP.KernelParams, spec: MeshSpec,
-                 events=None, fleet_stats_every: int = 10) -> None:
+                 events=None, fleet_stats_every: int = 10,
+                 pipeline_depth: int = 0) -> None:
         devs = jax.devices()
         need = spec.g_size * spec.replicas
         if len(devs) < need:
@@ -79,7 +81,8 @@ class MeshEngine(KernelEngine):
             n_local=spec.n_local, num_groups=spec.g_size * spec.n_local)
         total = self.cluster.total_rows
         super().__init__(kp, total, send_message=None, events=events,
-                         fleet_stats_every=fleet_stats_every)
+                         fleet_stats_every=fleet_stats_every,
+                         pipeline_depth=pipeline_depth)
         # replica ids are fixed by the mesh addressing (route() targets
         # rid 1..R); rows keep them even while ABSENT
         rids = np.empty((total,), np.int32)
@@ -94,6 +97,11 @@ class MeshEngine(KernelEngine):
         # mesh, not the host queues)
         self.box = self.cluster.shard(empty_inbox(kp, total))
         self._pending_msgs = 0
+        # device scalar from the LAST step, synced to the host lazily in
+        # _device_pending: the eager int() forced the step loop to block
+        # on the whole device step right at dispatch, defeating the
+        # pipelined overlap
+        self._pending_dev = None
         # partition mask; device copy cached until the mask changes
         self._cut = np.zeros((total,), bool)
         self._cut_dev = None
@@ -186,7 +194,9 @@ class MeshEngine(KernelEngine):
             "mesh engine removes per-replica: use remove_replica(node)")
 
     def _is_registered(self, n: KernelNode) -> bool:
-        return (n.shard_id, n.replica_id) in self.by_shard
+        # identity, for the same reason as the base engine: a deferred
+        # retire must not mistake a re-admitted replica for this node
+        return self.by_shard.get((n.shard_id, n.replica_id)) is n
 
     def _mirror_floor(self, n: KernelNode) -> int:
         members = self._members.get(n.shard_id, {}).values()
@@ -205,6 +215,10 @@ class MeshEngine(KernelEngine):
     # -- the step ----------------------------------------------------------
 
     def _device_pending(self) -> bool:
+        p = self._pending_dev
+        if p is not None:
+            self._pending_dev = None
+            self._pending_msgs = int(p)
         return self._pending_msgs > 0
 
     def _fleet_inbox_from(self):
@@ -223,17 +237,20 @@ class MeshEngine(KernelEngine):
         state, box, out, pending = ici_serve_step(
             cl, self.state, self.box, staged, self._cut_dev)
         self.box = box
-        self._pending_msgs = int(pending)
+        # keep the pending count device-side; the next _device_pending
+        # call syncs it (after staging has already overlapped the step)
+        self._pending_dev = pending
         return state, out
 
-    def _emit_messages(self, g, n, o, pid, kind, replicates, others) -> None:
+    def _emit_messages(self, g, n, o, fl, pid, kind,
+                       replicates, others) -> None:
         # intra-group messages ride the mesh inside the step; there is
         # nothing for the host to send (READ_INDEX forwarding and
         # snapshot streams go through the per-node host path).  A witness
         # peer needing a snapshot CANNOT be served over the mesh (witness
         # replicas are host-resident, their mesh row is absent) — the
         # group escalates to the host engines, which recover it
-        if o["s_wit_snap"][g].any():
+        if fl[_F_WITSNAP] and o["s_wit_snap"][g].any():
             self._wit_snap_fallback.add(n.shard_id)
 
     def _prop_target(self, n: KernelNode):
@@ -305,6 +322,7 @@ class MeshEngine(KernelEngine):
                     kind=s.kind.at[member.lane].set(jk),
                 )
                 self._kind_np[member.lane] = kinds
+                self._pid_np[member.lane] = pids
         self.state = s
 
     def _evict(self, n: KernelNode, reason: str, carry=None) -> None:
@@ -333,13 +351,16 @@ _REG_MU = threading.Lock()
 
 
 def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
-                       events=None, fleet_stats_every: int = 10
-                       ) -> MeshEngine:
+                       events=None, fleet_stats_every: int = 10,
+                       pipeline_depth: int = 0) -> MeshEngine:
     with _REG_MU:
         eng = _REGISTRY.get(spec.name)
         if eng is None:
+            # the first attaching host's pipeline depth wins (the engine
+            # is process-wide; geometry/kp mismatches raise below)
             eng = MeshEngine(kp, spec, events=events,
-                             fleet_stats_every=fleet_stats_every)
+                             fleet_stats_every=fleet_stats_every,
+                             pipeline_depth=pipeline_depth)
             _REGISTRY[spec.name] = eng
         else:
             if eng.spec != spec:

@@ -587,7 +587,8 @@ class NodeHost:
             self.kernel_engine = KernelEngine(
                 self._kernel_params(), ex.kernel_capacity,
                 self._send_message, events=self.events,
-                fleet_stats_every=ex.fleet_stats_every)
+                fleet_stats_every=ex.fleet_stats_every,
+                pipeline_depth=ex.kernel_pipeline_depth)
             self.kernel_engine.on_evict = self._on_kernel_evict
         init = self._build_lane_init(node, members)
         self._inject_into_engine(self.kernel_engine, node, init,
@@ -687,7 +688,8 @@ class NodeHost:
                 kp = self._kernel_params(min_inbox=5 * (spec.replicas - 1))
                 self.mesh_engine = attach_mesh_engine(
                     kp, spec, events=self.events,
-                    fleet_stats_every=self.config.expert.fleet_stats_every)
+                    fleet_stats_every=self.config.expert.fleet_stats_every,
+                    pipeline_depth=self.config.expert.kernel_pipeline_depth)
             except Exception as e:
                 # not enough devices, or geometry mismatch with an
                 # already-attached engine

@@ -109,6 +109,68 @@ def step_loop_ab(G: int, steps: int) -> dict:
     return out
 
 
+def pipeline_loop_ab(G: int, pipe_iters: int) -> dict:
+    """Serial run_steps vs the fused depth-1 run_steps_pipelined at
+    matched micro-step counts (serial iters = 2 * pipe_iters) — the
+    device-side cost of the pipelined loop body (PR 6 tentpole)."""
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+        run_steps_pipelined,
+    )
+
+    kp = bench_params(3)
+    out = {}
+    for tag, loop, iters in (("serial", run_steps, 2 * pipe_iters),
+                             ("pipelined", run_steps_pipelined, pipe_iters)):
+        try:
+            state, box = elect_all(kp, 3, make_cluster(kp, G, 3))
+            # warm the EXACT executable (iters is a static arg)
+            state, box = loop(kp, 3, iters, True, True, state, box)
+            jax.block_until_ready(state.term)
+            t0 = time.time()
+            state, box = loop(kp, 3, iters, True, True, state, box)
+            jax.block_until_ready(state.term)
+            micro = iters * (2 if tag == "pipelined" else 1)
+            out[tag + "_step_ms"] = round(
+                (time.time() - t0) / micro * 1e3, 3)
+        except Exception as e:
+            out[tag + "_error"] = str(e)[-200:]
+    return out
+
+
+def gather_donated_ab(G: int, iters: int = 30) -> dict:
+    """Single-dispatch step vs step_donated at the bench shape: the hot
+    gather paths (log window fetch, inbox route) re-lowered with buffer
+    donation, which lets XLA write outputs over the dead input SoA
+    arrays instead of allocating per step.  Both arms pay the same
+    host-side empty-inbox/input staging, as the engine does."""
+    from dragonboat_tpu.bench_loop import bench_params, elect_all, make_cluster
+    from dragonboat_tpu.core.kernel import step, step_donated
+    from dragonboat_tpu.core.kstate import empty_inbox, empty_input
+
+    kp = bench_params(3)
+    out = {}
+    for tag, fn in (("step", step), ("step_donated", step_donated)):
+        try:
+            state, _ = elect_all(kp, 3, make_cluster(kp, G, 3))
+            n = state.term.shape[0]
+            state, _ = fn(kp, state, empty_inbox(kp, n),
+                          empty_input(kp, n))           # compile
+            jax.block_until_ready(state.term)
+            t0 = time.time()
+            for _ in range(iters):
+                state, _ = fn(kp, state, empty_inbox(kp, n),
+                              empty_input(kp, n))
+            jax.block_until_ready(state.term)
+            out[tag + "_ms"] = round((time.time() - t0) / iters * 1e3, 3)
+        except Exception as e:
+            out[tag + "_error"] = str(e)[-200:]
+    return out
+
+
 def main() -> None:
     g = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
         else 1024
@@ -127,9 +189,17 @@ def main() -> None:
     rec.update(bare_apply_ab(g * 3, AB))
     print("bare: " + json.dumps(rec), flush=True)
     rec.update(step_loop_ab(g, steps=max(10, min(50, 100_000 // g))))
+    # pipelined-loop + donated-dispatch rungs (PR 6) as their own
+    # kind-tagged line so downstream greps select by rung family
+    pipe = {"ts": time.time(), "kind": "pipeline_ab", "platform": plat,
+            "groups": g}
+    pipe.update(pipeline_loop_ab(g, pipe_iters=max(5, min(25, 50_000 // g))))
+    pipe.update(gather_donated_ab(g))
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps(pipe) + "\n")
     print(json.dumps(rec), flush=True)
+    print(json.dumps(pipe), flush=True)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,17 @@ def test_schedule_converges_slow(seed):
 
 
 @pytest.mark.chaos_fast
+def test_schedule_converges_on_pipelined_kernel():
+    """Faults against device-resident shards served through the depth-1
+    pipelined engine loop (PR 6): a kill/crash now lands while a donated
+    step is in flight, and restart/recovery must still converge with the
+    same oracle.  Seed 1 covers kill + torn crash_write + breaker + drop."""
+    r = run_schedule(1, device_resident=True, pipeline_depth=1)
+    assert r.report.ok, r.report.failures
+    assert r.acked_count > 0
+
+
+@pytest.mark.chaos_fast
 def test_schedule_trace_is_byte_identical_and_replayable():
     """The deterministic-replay contract (COVERAGE.md): the same seed
     twice yields byte-identical fault traces, and the recorded plan JSON

@@ -1,0 +1,76 @@
+"""Depth-1 pipelined loops vs the depth-0 serial oracle — bitwise.
+
+The pipelined (double-pumped) bench loops fuse two protocol micro-steps
+per fori_loop body (bench_loop.run_steps_pipelined and friends); the
+engine's PipelineConfig depth-1 mode rides the same kernel.  The whole
+carry is i32/bool (threefry included), so fusing the pair must be
+bitwise-neutral: ``run_steps_pipelined(n)`` ≡ ``run_steps(2n)``
+leaf-for-leaf.  Phase plan mirrors test_diff_onehot_reads_lockstep:
+elect, drop storm, write load, mixed reads — ≥300 driven micro-steps,
+every state leaf (and the final inbox) compared bitwise at each phase
+end."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("seed", [5, 42])
+def test_diff_pipelined_lockstep(seed):
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+        run_steps_mixed,
+        run_steps_mixed_pipelined,
+        run_steps_pipelined,
+        run_steps_storm,
+        run_steps_storm_pipelined,
+    )
+
+    kp = bench_params(3)
+    state0, box0 = elect_all(kp, 3, make_cluster(kp, 64, 3))
+    snap = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+
+    def drive_serial():
+        state, box = state0, box0
+        snaps = [snap(state)]
+        state, box = run_steps_storm(kp, 3, 100, 0.25, seed, state, box)
+        snaps.append(snap(state))
+        state, box = run_steps(kp, 3, 100, True, True, state, box)
+        snaps.append(snap(state))
+        state, box, reads = run_steps_mixed(
+            kp, 3, 100, max(1, kp.proposal_cap // 8),
+            np.int32(7), state, box, np.int32(0))
+        snaps.append(snap(state))
+        return snaps, snap(box), int(reads)
+
+    def drive_pipelined():
+        state, box = state0, box0
+        snaps = [snap(state)]
+        state, box = run_steps_storm_pipelined(
+            kp, 3, 50, 0.25, seed, state, box)
+        snaps.append(snap(state))
+        state, box = run_steps_pipelined(kp, 3, 50, True, True, state, box)
+        snaps.append(snap(state))
+        state, box, reads = run_steps_mixed_pipelined(
+            kp, 3, 50, max(1, kp.proposal_cap // 8),
+            np.int32(7), state, box, np.int32(0))
+        snaps.append(snap(state))
+        return snaps, snap(box), int(reads)
+
+    a, box_a, reads_a = drive_serial()
+    b, box_b, reads_b = drive_pipelined()
+    phases = ("elect", "storm", "write", "mixed")
+    for phase, sa, sb in zip(phases, a, b):
+        for name, va, vb in zip(sa._fields, sa, sb):
+            assert np.array_equal(va, vb), \
+                f"phase {phase} field {name} diverged (seed {seed})"
+    for name, va, vb in zip(box_a._fields, box_a, box_b):
+        if va is None and vb is None:
+            continue
+        assert np.array_equal(va, vb), \
+            f"final inbox field {name} diverged (seed {seed})"
+    assert reads_a == reads_b, "completed-read counters diverged"
