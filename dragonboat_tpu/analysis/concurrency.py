@@ -53,6 +53,7 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/chaos/crashfs.py",
     "dragonboat_tpu/telemetry.py",
     "dragonboat_tpu/flight.py",
+    "dragonboat_tpu/lifecycle.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

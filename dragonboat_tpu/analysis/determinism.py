@@ -43,6 +43,10 @@ DEFAULT_GLOBS = (
     # stamps records with a caller-side monotonic sequence
     "dragonboat_tpu/telemetry.py",
     "dragonboat_tpu/flight.py",
+    # the lifecycle tracer follows the same contract: its microsecond
+    # clock is INJECTED (tracing.monotonic_us lives outside this scope),
+    # so the module itself names no wall clock
+    "dragonboat_tpu/lifecycle.py",
 )
 
 WALL_CLOCK = {

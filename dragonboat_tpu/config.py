@@ -160,6 +160,18 @@ class ExpertConfig:
     # oracle); 1 overlaps host staging/output-retirement with the device
     # step, dispatching through the donating jit entry
     kernel_pipeline_depth: int = 0
+    # proposal-lifecycle tracing (lifecycle.py): every Nth proposal key
+    # carries an end-to-end span stamped at each host hop (propose,
+    # stage, dispatch, retire, save, fsync, apply, ack) and feeds the
+    # commit_stage_us{stage=} histograms + the /trace Chrome-trace ring;
+    # 0 disables sampling entirely
+    trace_sample_every: int = 64
+    # slow-commit SLO in microseconds: a sampled commit whose
+    # propose->ack total exceeds this records a flight-recorder
+    # slow_commit event with the full stage breakdown; 0 disables (the
+    # default keeps chaos-replay flight tails byte-identical, since the
+    # breakdown carries measured wall durations)
+    trace_slow_commit_us: int = 0
 
 
 @dataclass

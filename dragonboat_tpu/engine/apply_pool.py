@@ -19,6 +19,8 @@ import threading
 from collections import deque
 from typing import Callable
 
+from dragonboat_tpu import lifecycle
+
 
 class ApplyPool:
     def __init__(self, num_workers: int = 4,
@@ -37,15 +39,19 @@ class ApplyPool:
             t.start()
             self._threads.append(t)
 
-    def submit(self, key, fn: Callable[[], None]) -> None:
-        """Enqueue ``fn`` on ``key``'s serial lane."""
+    def submit(self, key, fn: Callable[[], None],
+               trace_keys: tuple = ()) -> None:
+        """Enqueue ``fn`` on ``key``'s serial lane.  ``trace_keys`` are
+        sampled proposal keys riding in this closure: the worker stamps
+        their lifecycle spans when the closure actually starts, so the
+        apply_queue->apply delta measures real pool dwell."""
         with self._cv:
             if self._stopped:
                 return
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = deque()
-            q.append(fn)
+            q.append((fn, trace_keys))
             if key not in self._running and key not in self._ready:
                 self._ready.append(key)
                 self._cv.notify()
@@ -83,7 +89,9 @@ class ApplyPool:
                 batch, self._queues[key] = q, deque()
                 self._running.add(key)
             try:
-                for fn in batch:
+                for fn, trace_keys in batch:
+                    for tk in trace_keys:
+                        lifecycle.TRACER.stamp(tk, lifecycle.STAGE_APPLY)
                     try:
                         fn()
                     except Exception:

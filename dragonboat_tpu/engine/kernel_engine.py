@@ -50,8 +50,9 @@ from dataclasses import dataclass, field, replace as _dc_replace
 import jax.numpy as jnp
 import numpy as np
 
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
-from dragonboat_tpu.tracing import annotate
+from dragonboat_tpu.tracing import annotate, stop_env_trace
 from dragonboat_tpu.config import Config
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.kernel import (
@@ -128,6 +129,10 @@ class _StepCtx:
     staged_rows: set[int]
     out: object = None                      # device StepOutput (async)
     dead: set[int] = field(default_factory=set)   # rows removed in flight
+    # lifecycle-sampled proposal keys riding this step (dispatch/retire
+    # stamps); keys of rows scrubbed in flight stay here harmlessly —
+    # stamp() is a no-op once the book's dropped() scrubbed the span
+    traced: list = field(default_factory=list)
 
 
 class KernelNode(Node):
@@ -439,6 +444,14 @@ class KernelEngine:
             self._clear_lane(node.lane)
             self._removed_nodes.append(node)
         return node
+
+    def close(self) -> None:
+        """Engine teardown.  Flushes a DRAGONBOAT_TPU_TRACE_DIR-armed
+        profiler capture while the JAX backend is unambiguously alive —
+        relying on atexit for it races interpreter/backend shutdown and
+        can leave the trace dir empty (a user-started ``start_trace``
+        capture is deliberately left to its owner)."""
+        stop_env_trace()
 
     def _inject(self, lane: int, node: KernelNode, init: _LaneInit) -> None:
         """Queue one lane injection; the next ``step_all`` flushes every
@@ -766,6 +779,10 @@ class KernelEngine:
                            if n._staged_ri is not None},
                 staged_rows=set(self._staged_rows),
             )
+            if lifecycle.TRACER.enabled:
+                ctx.traced = [e.key for fl in ctx.fates.values()
+                              for e, _origin in fl
+                              if e.key and lifecycle.TRACER.sampled(e.key)]
             with self._step_timer.measure():
                 overlapped = self._pending_ctx is not None
                 if overlapped:
@@ -787,6 +804,8 @@ class KernelEngine:
                         state, out = self._kernel_call(inbox, inp)
                 self.state = state
                 ctx.out = out
+                for k in ctx.traced:
+                    lifecycle.TRACER.stamp(k, lifecycle.STAGE_DISPATCH)
                 self._pipe_steps += 1
                 if self.pipeline_depth > 0:
                     # defer the fetch: the outputs are consumed one step
@@ -1035,6 +1054,8 @@ class KernelEngine:
                 continue
             inp.prop(tg, slot, False)
             tn._staged_props.append((e, n))
+            if e.key:
+                lifecycle.TRACER.stamp(e.key, lifecycle.STAGE_STAGE)
             slot += 1
         self._slot_cursor[tg] = slot
 
@@ -1057,6 +1078,8 @@ class KernelEngine:
         the eager 42-field np.asarray sweep was ~80% of step wall clock
         at 20k lanes."""
         nodes, out = ctx.nodes, ctx.out
+        for k in ctx.traced:
+            lifecycle.TRACER.stamp(k, lifecycle.STAGE_RETIRE)
         flags = np.asarray(output_row_flags(out))
         o = _LazyOut(out)
         pid = self._pid_np
@@ -1150,6 +1173,11 @@ class KernelEngine:
             by_db: dict[int, tuple[object, list]] = {}
             for n, ud in updates:
                 by_db.setdefault(id(n.logdb), (n.logdb, []))[1].append(ud)
+                if lifecycle.TRACER.enabled:
+                    for e in ud.entries_to_save:
+                        if e.key:
+                            lifecycle.TRACER.stamp(
+                                e.key, lifecycle.STAGE_SAVE)
             for db, uds in by_db.values():
                 db.save_raft_state(uds, worker_id=0)
         for sender, m in others:
@@ -1357,6 +1385,10 @@ class KernelEngine:
                 if e.key:
                     n.pending_proposals.committed(e.key)
         results = n.sm.handle(entries)
+        if lifecycle.TRACER.enabled:
+            for e in entries:
+                if e.key:
+                    lifecycle.TRACER.stamp(e.key, lifecycle.STAGE_APPLY)
         cc_applied = False
         for r in results:
             entry = next(e for e in entries if e.index == r.index)

@@ -379,3 +379,7 @@ def detach_mesh_engine(eng: MeshEngine) -> None:
         eng._refs -= 1
         if eng._refs <= 0:
             _REGISTRY.pop(eng.spec.name, None)
+            # last host off the mesh: flush an env-armed profiler
+            # capture now (KernelEngine.close semantics — the engine is
+            # shared, so only full detach may stop it)
+            eng.close()

@@ -27,6 +27,7 @@ BREAKER_TRIP = "breaker_trip"
 QUARANTINE = "quarantine"
 CHAOS_FAULT = "chaos_fault"
 EVICTION = "eviction"
+SLOW_COMMIT = "slow_commit"
 
 
 class FlightRecorder:

@@ -39,6 +39,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.logdb.tan import TanLogDB
 from dragonboat_tpu.raftio import ILogDB, NodeInfo, RaftState
@@ -241,12 +242,19 @@ class ShardedLogDB(ILogDB):
         if len(groups) == 1:
             pid, uds = next(iter(groups.items()))
             self._parts[pid].save_raft_state(uds, worker_id)
-            return
-        futs = [self._pool.submit(self._parts[pid].save_raft_state, uds,
-                                  worker_id)
-                for pid, uds in groups.items()]
-        for fu in futs:
-            fu.result()
+        else:
+            futs = [self._pool.submit(self._parts[pid].save_raft_state,
+                                      uds, worker_id)
+                    for pid, uds in groups.items()]
+            for fu in futs:
+                fu.result()
+        # lifecycle: entries in this batch are durable NOW — stamp the
+        # sampled ones after every touched partition has fsynced
+        if lifecycle.TRACER.enabled:
+            for ud in updates:
+                for e in ud.entries_to_save:
+                    if e.key:
+                        lifecycle.TRACER.stamp(e.key, lifecycle.STAGE_FSYNC)
 
     def iterate_entries(self, shard_id, replica_id, low, high, max_size):
         return self._part(shard_id).iterate_entries(

@@ -13,6 +13,7 @@ import os
 import threading
 from dataclasses import dataclass, field
 
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.client import Session
 from dragonboat_tpu.config import Config
@@ -89,7 +90,8 @@ class Node:
         # tick() advances itself
         self._clock = clock if clock is not None else LogicalClock()
         self._owns_clock = clock is None
-        self.pending_proposals = PendingProposal(clock=self._clock)
+        self.pending_proposals = PendingProposal(clock=self._clock,
+                                                 shard_id=cfg.shard_id)
         self.pending_reads = PendingReadIndex(clock=self._clock)
         self.pending_config_change = PendingSingleton(clock=self._clock)
         self.pending_snapshot = PendingSingleton(clock=self._clock)
@@ -550,12 +552,22 @@ class Node:
         # when one is wired so a slow user SM blocks only its own shard
         # (engine.go:1153-1204 apply workers), else inline
         if ud.committed_entries:
+            trace_keys = ()
+            if lifecycle.TRACER.enabled:
+                trace_keys = tuple(
+                    e.key for e in ud.committed_entries
+                    if e.key and lifecycle.TRACER.sampled(e.key))
+                for k in trace_keys:
+                    lifecycle.TRACER.stamp(k, lifecycle.STAGE_APPLY_QUEUE)
             if self.apply_pool is not None:
                 ents = ud.committed_entries
                 self.apply_pool.submit(
                     self.shard_id,
-                    lambda: self._apply_entries(ents, async_core=True))
+                    lambda: self._apply_entries(ents, async_core=True),
+                    trace_keys=trace_keys)
             else:
+                for k in trace_keys:
+                    lifecycle.TRACER.stamp(k, lifecycle.STAGE_APPLY)
                 self._apply_entries(ud.committed_entries)
         # auto snapshot (node.go:694 saveSnapshotRequired); on the async
         # path the apply worker posts the request itself

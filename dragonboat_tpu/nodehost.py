@@ -231,6 +231,15 @@ class NodeHost:
         self._apply_pool = ApplyPool(
             num_workers=max(1, min(nhconfig.expert.engine.apply_shards, 16)),
             on_work_done=self._work.set, name=f"apply-{self.id[:8]}")
+        # proposal-lifecycle tracing (lifecycle.py): re-point the
+        # process-global tracer at this host's expert knobs — the tracer
+        # is process-wide (like flight.RECORDER) so spans stay whole
+        # when a proposal crosses hosts over the in-proc transport
+        from dragonboat_tpu import lifecycle as _lifecycle
+
+        _lifecycle.TRACER.configure(
+            sample_every=nhconfig.expert.trace_sample_every,
+            slow_commit_us=nhconfig.expert.trace_slow_commit_us)
         # opt-in Prometheus /metrics endpoint (enable_metrics): serves
         # this host's registry + the process-global one (module-scoped
         # producers like the logdb latency histograms live there)
@@ -351,6 +360,11 @@ class NodeHost:
         for n in nodes:
             n.destroy()
             self.events.node_unloaded(NodeInfo(n.shard_id, n.replica_id))
+        if self.kernel_engine is not None:
+            # flushes a DRAGONBOAT_TPU_TRACE_DIR-armed profiler capture
+            # while the backend is still alive (atexit-only flush races
+            # interpreter shutdown)
+            self.kernel_engine.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None

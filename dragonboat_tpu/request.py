@@ -14,6 +14,7 @@ import threading
 from dataclasses import dataclass, field
 from enum import IntEnum
 
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.statemachine import Result
 
@@ -140,17 +141,28 @@ class PendingProposal(_ClockedBook):
     proposalShards) so concurrent client threads completing/registering
     different keys never serialize on one lock — the engine's apply
     path touches a different shard than the ingress path almost always.
-    The logical clock stays book-wide (ticks are engine-driven)."""
+    The logical clock stays book-wide (ticks are engine-driven).
+
+    Lifecycle tracing: entry keys come off the CLASS-level ``_seq``, so
+    they are process-unique — the 1-in-N span sampling in lifecycle.py
+    keys off them directly.  Every verb that removes a key from this
+    book ends its span: ``applied`` finishes it (the ack), while
+    ``dropped``/``gc``/``terminate_all`` scrub it — including the
+    engine's in-flight-removal paths, which all funnel through
+    ``dropped`` — so the span registry can never outlive the book."""
 
     _seq = itertools.count(1)
 
     def __init__(self, shards: int = 8,
-                 clock: LogicalClock | None = None) -> None:
+                 clock: LogicalClock | None = None,
+                 shard_id: int = 0) -> None:
         super().__init__(clock)
         self._shards: list[dict[int, RequestState]] = [   # guarded-by: _locks
             {} for _ in range(shards)]
         self._locks = [threading.Lock() for _ in range(shards)]
         self._n = shards                                  # guarded-by: <init-only>
+        # raft shard id this book serves (Chrome-trace pid grouping)
+        self.shard_id = shard_id                          # guarded-by: <init-only>
 
     @property
     def pending(self) -> dict[int, RequestState]:
@@ -174,6 +186,7 @@ class PendingProposal(_ClockedBook):
         i = key % self._n
         with self._locks[i]:
             self._shards[i][key] = rs
+        lifecycle.TRACER.begin(key, self.shard_id)
         return rs, entry
 
     def applied(self, key: int, client_id: int, series_id: int,
@@ -185,6 +198,7 @@ class PendingProposal(_ClockedBook):
             code = (RequestResultCode.REJECTED if rejected
                     else RequestResultCode.COMPLETED)
             rs.notify(RequestResult(code=code, result=result))
+            lifecycle.TRACER.finish(key)
 
     def committed(self, key: int) -> None:
         i = key % self._n
@@ -199,6 +213,7 @@ class PendingProposal(_ClockedBook):
             rs = self._shards[i].pop(key, None)
         if rs is not None:
             rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+            lifecycle.TRACER.scrub(key)
 
     def gc(self) -> None:
         # unlocked emptiness fast path: the amortized host sweep calls
@@ -212,16 +227,18 @@ class PendingProposal(_ClockedBook):
                 expired = [k for k, rs in d.items()
                            if rs.deadline_tick <= self.tick]
                 fired = [d.pop(k) for k in expired]
-            for rs in fired:
+            for k, rs in zip(expired, fired):
                 rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+                lifecycle.TRACER.scrub(k)
 
     def terminate_all(self) -> None:
         for i in range(self._n):
             with self._locks[i]:
-                fired = list(self._shards[i].values())
+                fired = list(self._shards[i].items())
                 self._shards[i].clear()
-            for rs in fired:
+            for k, rs in fired:
                 rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+                lifecycle.TRACER.scrub(k)
 
 
 class PendingReadIndex(_ClockedBook):

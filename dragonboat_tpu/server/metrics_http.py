@@ -4,7 +4,9 @@ Serves the Prometheus text exposition of one or more
 ``telemetry.Registry`` objects (a NodeHost serves its per-hub registry
 concatenated with the process-global one that module-scoped producers
 like the logdb engines write to), plus ``/flight`` — the flight
-recorder tail as JSON — and ``/healthz``.
+recorder tail as JSON — ``/trace`` — the lifecycle tracer's completed
+proposal spans as Chrome-trace-event JSON, loadable directly in
+Perfetto / chrome://tracing — and ``/healthz``.
 
 A ``ThreadingHTTPServer`` on a daemon thread: scrapes never run on an
 engine thread, and the collect path takes no registry lock while
@@ -14,10 +16,12 @@ scrape cannot invert against engine-held host locks.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dragonboat_tpu import flight
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu.logger import get_logger
 
 _LOG = get_logger("metrics_http")
@@ -29,10 +33,11 @@ class MetricsServer:
     """One /metrics listener over a list of registries."""
 
     def __init__(self, registries, address: str = "127.0.0.1:0",
-                 flight_recorder=None) -> None:
+                 flight_recorder=None, tracer=None) -> None:
         self.registries = list(registries)
         self.flight_recorder = (flight_recorder if flight_recorder
                                 is not None else flight.RECORDER)
+        self.tracer = tracer if tracer is not None else lifecycle.TRACER
         host, _, port = address.rpartition(":")
         if not host:
             host, port = address or "127.0.0.1", "0"
@@ -46,6 +51,11 @@ class MetricsServer:
                     ctype = CONTENT_TYPE
                 elif path == "/flight":
                     body = (outer.flight_recorder.dump_json(indent=2)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/trace":
+                    body = (json.dumps(outer.tracer.export_chrome_trace(),
+                                       sort_keys=True)
                             + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":

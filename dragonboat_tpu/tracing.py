@@ -25,6 +25,20 @@ import os
 import time
 
 _active_trace_dir: str | None = None
+# set while the ACTIVE capture was armed by DRAGONBOAT_TPU_TRACE_DIR
+# (maybe_start_from_env) rather than an explicit start_trace call —
+# engine close() stops env-armed captures, never user-started ones
+_env_armed = False
+
+
+def monotonic_us() -> int:
+    """Monotonic microsecond clock for lifecycle stage stamps.
+
+    Lives HERE (outside the determinism lint scope) so lifecycle.py can
+    receive it by injection: the tracer module itself never names a wall
+    clock, tests inject a deterministic counter, and the lint keeps the
+    replay-path modules honest."""
+    return time.monotonic_ns() // 1000
 
 
 def start_trace(trace_dir: str) -> None:
@@ -47,14 +61,30 @@ def start_trace(trace_dir: str) -> None:
 
 def stop_trace() -> str | None:
     """End the capture; returns the trace dir (None if none active)."""
-    global _active_trace_dir
+    global _active_trace_dir, _env_armed
     if _active_trace_dir is None:
         return None
     import jax
 
     jax.profiler.stop_trace()
     d, _active_trace_dir = _active_trace_dir, None
+    _env_armed = False
     return d
+
+
+def stop_env_trace() -> str | None:
+    """Stop the capture ONLY when it was armed by the environment
+    (``DRAGONBOAT_TPU_TRACE_DIR``); returns the flushed dir, or None.
+
+    Engine ``close()`` calls this: JAX only serializes a capture on
+    stop, so an env-armed trace that survived to interpreter shutdown
+    depended on atexit LIFO ordering to flush at all — a host that is
+    closed deliberately should flush its capture right there, while the
+    backend is unambiguously alive.  A capture the USER started with
+    ``start_trace`` is left alone (they own its lifetime)."""
+    if not _env_armed:
+        return None
+    return stop_trace()
 
 
 _env_hook_registered = False
@@ -81,12 +111,13 @@ def maybe_start_from_env() -> bool:
     imported jax — or the profiler would try to serialize the capture
     into an already-torn-down backend.  The hook is registered exactly
     once per process."""
-    global _env_hook_registered
+    global _env_hook_registered, _env_armed
     d = os.environ.get("DRAGONBOAT_TPU_TRACE_DIR")
     if d and _active_trace_dir is None:
         import atexit
 
         start_trace(d)          # imports jax; its atexit hooks exist now
+        _env_armed = True
         if not _env_hook_registered:
             _env_hook_registered = True
             atexit.register(_atexit_stop)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
 
@@ -124,6 +125,17 @@ class ChanTransport(ITransport):
             if d > 0:
                 threading.Timer(d, self.message_handler, (batch,)).start()
                 return
+        # lifecycle sidecar (in-proc transport only): sampled replicate
+        # entries arrived at the destination host — the process-global
+        # tracer sees the proposer's span directly, so nothing is encoded
+        # into the batch and the wire formats stay untouched
+        if lifecycle.TRACER.enabled:
+            for m in batch.requests:
+                if m.type == pb.MessageType.REPLICATE:
+                    for e in m.entries:
+                        if e.key:
+                            lifecycle.TRACER.stamp(
+                                e.key, lifecycle.STAGE_HUB_RECV)
         self.message_handler(batch)
 
     def deliver_chunk(self, chunk: dict) -> None:

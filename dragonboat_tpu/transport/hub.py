@@ -16,6 +16,7 @@ from random import Random
 from typing import Callable
 
 from dragonboat_tpu import flight
+from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.events import EventHub
 from dragonboat_tpu.raftio import INodeRegistry, ITransport, SnapshotInfo
@@ -251,6 +252,16 @@ class TransportHub:
                 conn.send_message_batch(batch)
                 b.succeed()
                 self.metrics.inc("transport.sent", len(msgs))
+                # lifecycle sidecar: replicated entries left this host —
+                # stamp the sampled spans in-process (nothing rides the
+                # wire; go-wire interop is untouched)
+                if lifecycle.TRACER.enabled:
+                    for m in msgs:
+                        if m.type == pb.MessageType.REPLICATE:
+                            for e in m.entries:
+                                if e.key:
+                                    lifecycle.TRACER.stamp(
+                                        e.key, lifecycle.STAGE_HUB_SEND)
                 self._note_connection(a, True, False)
             except Exception:
                 if b.fail():

@@ -10,6 +10,14 @@ raw text or a flat JSON object.
     python scripts/metrics_dump.py 127.0.0.1:9090
     python scripts/metrics_dump.py 127.0.0.1:9090 --json
     python scripts/metrics_dump.py 127.0.0.1:9090 --flight
+    python scripts/metrics_dump.py 127.0.0.1:9090 --trace > trace.json
+
+``--trace`` scrapes /trace — the proposal-lifecycle spans as
+Chrome-trace-event JSON — and validates it strictly
+(lifecycle.validate_chrome_trace: required ph/ts/pid/tid keys,
+monotone non-negative timestamps per span) before printing; the
+output loads directly in Perfetto (ui.perfetto.dev) or
+chrome://tracing.
 
 Stdlib-only on the wire (urllib); exit status is non-zero when the
 endpoint is unreachable or the exposition fails strict parsing.
@@ -39,18 +47,43 @@ def main() -> int:
     ap.add_argument("--flight", action="store_true",
                     help="dump /flight (the flight-recorder tail) instead "
                          "of /metrics")
+    ap.add_argument("--trace", action="store_true",
+                    help="dump /trace (proposal-lifecycle spans as "
+                         "Chrome-trace-event JSON, Perfetto-loadable) "
+                         "instead of /metrics")
     ap.add_argument("--no-validate", action="store_true",
-                    help="skip strict exposition parsing")
+                    help="skip strict validation (exposition parsing / "
+                         "Chrome-trace checks)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args()
 
-    path = "/flight" if args.flight else "/metrics"
+    path = ("/trace" if args.trace
+            else "/flight" if args.flight else "/metrics")
     try:
         text = fetch(args.address, path, args.timeout)
     except (urllib.error.URLError, OSError) as e:
         print(f"error: cannot scrape http://{args.address}{path}: {e}",
               file=sys.stderr)
         return 2
+
+    if args.trace:
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            print(f"error: /trace is not valid JSON: {e}", file=sys.stderr)
+            return 1
+        if not args.no_validate:
+            from dragonboat_tpu.lifecycle import validate_chrome_trace
+
+            try:
+                n = validate_chrome_trace(obj)
+            except ValueError as e:
+                print(f"error: Chrome-trace validation failed: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"ok: {n} trace event(s)", file=sys.stderr)
+        print(text, end="" if text.endswith("\n") else "\n")
+        return 0
 
     if args.flight:
         print(text, end="" if text.endswith("\n") else "\n")

@@ -1,5 +1,9 @@
 """Tracing hooks: step-latency accounting and profiler span no-ops."""
 
+import contextlib
+import itertools
+import time
+
 import pytest
 
 from dragonboat_tpu import tracing
@@ -22,10 +26,109 @@ def test_step_timer_feeds_metrics():
     assert snap["engine.test.latency_us.count"] == 3
 
 
+def test_step_timer_ewma_and_max_accounting(monkeypatch):
+    """EWMA: the first sample seeds it directly, later samples fold in
+    at 0.9/0.1; max tracks the largest sample.  perf_counter is stubbed
+    with a deterministic schedule so the arithmetic is exact."""
+    # three measures of 100us, 200us, 50us: each measure() reads the
+    # clock twice (entry, exit)
+    ticks = iter([0.0, 100e-6,
+                  1.0, 1.0 + 200e-6,
+                  2.0, 2.0 + 50e-6])
+    monkeypatch.setattr(time, "perf_counter", lambda: next(ticks))
+    m = Metrics()
+    t = StepTimer(m, "engine.test2")
+    for _ in range(3):
+        with t.measure():
+            pass
+    # 100 seeds; then 0.9*100+0.1*200 = 110; then 0.9*110+0.1*50 = 104
+    # (int truncation of the float microsecond values allows 1us slack)
+    assert t._ewma_us == pytest.approx(104.0, abs=0.5)
+    assert t._max_us == pytest.approx(200, abs=1)
+    snap = m.snapshot()
+    assert snap["engine.test2.steps"] == 3
+    assert snap["engine.test2.total_us"] == pytest.approx(350, abs=3)
+    assert snap["engine.test2.ewma_us"] == pytest.approx(104, abs=1)
+    assert snap["engine.test2.max_us"] == pytest.approx(200, abs=1)
+
+
 def test_annotate_is_safe_without_capture():
     with annotate("noop-span"):
         x = 1 + 1
     assert x == 2
+
+
+def test_annotate_is_nullcontext_without_capture(monkeypatch):
+    """With no active capture, annotate must return a plain
+    nullcontext — no jax import, no TraceAnnotation object (the hot
+    path relies on this being free)."""
+    monkeypatch.setattr(tracing, "_active_trace_dir", None)
+    cm = annotate("should-be-free")
+    assert isinstance(cm, contextlib.nullcontext)
+
+
+def test_monotonic_us_is_monotone():
+    a = tracing.monotonic_us()
+    b = tracing.monotonic_us()
+    assert isinstance(a, int) and b >= a >= 0
+
+
+class _FakeProfiler:
+    """Stands in for jax.profiler: records start/stop calls."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    monkeypatch.setattr(tracing, "_active_trace_dir", None)
+    monkeypatch.setattr(tracing, "_env_armed", False)
+    yield fake
+    # never leak an armed capture into the next test
+    tracing._active_trace_dir = None
+    tracing._env_armed = False
+
+
+def test_stop_env_trace_ignores_user_capture(fake_profiler, tmp_path):
+    """A capture the user started with start_trace is NOT env-armed:
+    stop_env_trace must leave it running (the user owns its lifetime)."""
+    tracing.start_trace(str(tmp_path))
+    assert tracing.stop_env_trace() is None
+    assert tracing._active_trace_dir == str(tmp_path)
+    assert tracing.stop_trace() == str(tmp_path)
+
+
+def test_engine_close_stops_env_armed_trace(fake_profiler, tmp_path,
+                                            monkeypatch):
+    """Regression (satellite): an env-armed capture must be stopped and
+    flushed by engine close(), not left to atexit ordering."""
+    from dragonboat_tpu.core import params as KP
+    from dragonboat_tpu.engine.kernel_engine import KernelEngine
+
+    d = str(tmp_path / "cap")
+    monkeypatch.setenv("DRAGONBOAT_TPU_TRACE_DIR", d)
+    eng = KernelEngine(KP.KernelParams(), capacity=4, send_message=None)
+    assert tracing._active_trace_dir == d
+    assert tracing._env_armed
+    eng.close()
+    assert tracing._active_trace_dir is None
+    assert not tracing._env_armed
+    assert ("start", d) in fake_profiler.calls
+    assert ("stop", None) in fake_profiler.calls
+    # idempotent: a second close must not double-stop
+    eng.close()
+    assert fake_profiler.calls.count(("stop", None)) == 1
 
 
 def test_double_start_trace_raises(tmp_path, monkeypatch):
