@@ -137,15 +137,27 @@ class ContractError(ValueError):
     pass
 
 
+#: legal values of the ``part=`` tag: data that lives per-group and must be
+#: sharded along the mesh G axis, vs data that is identical on every device
+PARTS = ("G", "replicated")
+
+#: legal values of the ``collective=`` tag: ``declared`` marks a struct whose
+#: fields are PRODUCED by an intentional cross-G collective (fleet stats);
+#: ``none`` (the default) means cross-G data flow into the field is a bug
+COLLECTIVES = ("none", "declared")
+
+
 @dataclass(frozen=True)
 class FieldContract:
-    """One parsed ``"[G, P] i32 domain=A..B ring optional"`` string."""
+    """One parsed ``"[G, P] i32 domain=A..B ring optional part=G"`` string."""
 
     axes: tuple[str, ...]          # symbolic axis names, () = scalar
     dtype: str                     # one of DTYPES
     ring: bool = False             # power-of-two ring: indexing must mask
     optional: bool = False         # field may be None under some configs
     domain: tuple[str, str] | None = None  # (lo_name, hi_name) in params.py
+    part: str | None = None        # one of PARTS, None = undeclared
+    collective: str | None = None  # one of COLLECTIVES, None = undeclared
 
 
 def parse_contract(spec: str, where: str = "<contract>") -> FieldContract:
@@ -168,6 +180,7 @@ def parse_contract(spec: str, where: str = "<contract>") -> FieldContract:
                             f"(want one of {DTYPES}): {spec!r}")
     ring = optional = False
     domain = None
+    part = collective = None
     for t in tags:
         if t == "ring":
             ring = True
@@ -179,10 +192,22 @@ def parse_contract(spec: str, where: str = "<contract>") -> FieldContract:
                 raise ContractError(f"{where}: bad domain tag {t!r} "
                                     "(want domain=LO..HI)")
             domain = (lo, hi)
+        elif t.startswith("part="):
+            part = t[len("part="):]
+            if part not in PARTS:
+                raise ContractError(f"{where}: bad part tag {t!r} "
+                                    f"(want part={'|'.join(PARTS)})")
+        elif t.startswith("collective="):
+            collective = t[len("collective="):]
+            if collective not in COLLECTIVES:
+                raise ContractError(
+                    f"{where}: bad collective tag {t!r} "
+                    f"(want collective={'|'.join(COLLECTIVES)})")
         else:
             raise ContractError(f"{where}: unknown tag {t!r}: {spec!r}")
     return FieldContract(axes=axes, dtype=dtype, ring=ring,
-                         optional=optional, domain=domain)
+                         optional=optional, domain=domain,
+                         part=part, collective=collective)
 
 
 def parse_contracts(table: dict, where: str = "<contracts>"

@@ -118,6 +118,9 @@ class AVal:
     strip: tuple[str, ...] = ()          # axes stripped from cls's fields
     tup: tuple | None = None             # tuple value (AVal elements)
     dt_marker: str | None = None         # value IS a dtype (I32, jnp.bool_)
+    part: str | None = None              # partition: 'G' | 'rep' | None
+    bcast: bool = False                  # replicated value explicitly
+    #                                      broadcast to a G-shaped operand
 
 
 UNKNOWN = AVal()

@@ -108,8 +108,13 @@ def load_budget(path: str) -> dict:
 
 
 def source_hash(root: str, cfg: dict | None = None) -> str:
-    """sha256 over the kernel-defining sources + measurement config."""
+    """sha256 over the kernel-defining sources + measurement config +
+    the jax version (a compiler upgrade changes the optimized HLO even
+    when no repo source moved — the cache must not outlive it)."""
+    import jax
+
     h = hashlib.sha256()
+    h.update(("jax:" + getattr(jax, "__version__", "unknown")).encode())
     for src in CACHE_SOURCES:
         p = os.path.join(root, src)
         h.update(src.encode())

@@ -32,6 +32,28 @@ ROLE_NAMES = ("follower", "candidate", "pre_vote_candidate", "leader",
 LAG_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64)
 INBOX_BUCKETS = (0, 1, 2, 4, 8)
 
+# Partition contract for the stats struct (grammar: core/kstate.py
+# CONTRACTS; checked by analysis/partition.py).  Every field is an
+# aggregate over ALL groups: replicated on every device, and produced by
+# an intentional cross-G collective — `collective=declared` licenses the
+# cross-G reductions inside _fleet_stats_impl that the partition pass
+# would otherwise flag as PS001.  Axis names: ROLES == NUM_ROLES,
+# LAGB/INBOXB == len(*_BUCKETS)+1 (host-side constants, not kernel
+# geometry — the shape side of this table is documentation, the
+# part/collective side is machine-checked).
+CONTRACTS = {
+    "FleetStats": {
+        "occupied": "[] i32 part=replicated collective=declared",
+        "role_count": "[ROLES] i32 part=replicated collective=declared",
+        "leaderless": "[] i32 part=replicated collective=declared",
+        "election_active": "[] i32 part=replicated collective=declared",
+        "term_max": "[] i32 part=replicated collective=declared",
+        "term_min": "[] i32 part=replicated collective=declared",
+        "lag_hist": "[LAGB] i32 part=replicated collective=declared",
+        "inbox_hist": "[INBOXB] i32 part=replicated collective=declared",
+    },
+}
+
 
 def bucket_labels(bounds) -> tuple:
     return tuple(str(b) for b in bounds) + ("+Inf",)

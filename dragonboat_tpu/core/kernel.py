@@ -50,41 +50,43 @@ MT = pb.MessageType
 
 # Contracts for the kernel-local structs (grammar: core/kstate.py
 # CONTRACTS).  These are PER-SHARD shapes — the kernel body runs under
-# vmap, so there is no [G] axis here; scalars are "[]".
+# vmap, so there is no [G] axis here; scalars are "[]".  part=G: the
+# values are still per-group data (each group computes its own), so at
+# the mesh level they live G-sharded like the kstate structs.
 CONTRACTS = {
     "Effects": {
-        "need_rep": "[P] bool",
-        "need_hb": "[] bool",
-        "hb_low": "[] i32",
-        "hb_high": "[] i32",
-        "send_vote": "[] i32",
-        "vote_hint": "[] i32",
-        "send_tn": "[P] bool",
-        "rtr_valid": "[RI] bool",
-        "rtr_index": "[RI] i32",
-        "rtr_low": "[RI] i32",
-        "rtr_high": "[RI] i32",
-        "rtr_n": "[] i32",
-        "save_from": "[] i32",
-        "ri_dropped": "[] bool",
+        "need_rep": "[P] bool part=G",
+        "need_hb": "[] bool part=G",
+        "hb_low": "[] i32 part=G",
+        "hb_high": "[] i32 part=G",
+        "send_vote": "[] i32 part=G",
+        "vote_hint": "[] i32 part=G",
+        "send_tn": "[P] bool part=G",
+        "rtr_valid": "[RI] bool part=G",
+        "rtr_index": "[RI] i32 part=G",
+        "rtr_low": "[RI] i32 part=G",
+        "rtr_high": "[RI] i32 part=G",
+        "rtr_n": "[] i32 part=G",
+        "save_from": "[] i32 part=G",
+        "ri_dropped": "[] bool part=G",
     },
     "_Pre": {
-        "act": "[] bool",
-        "is_leader": "[] bool",
-        "is_candidate": "[] bool",
-        "is_follower_like": "[] bool",
-        "sender_known": "[] bool",
-        "sender_slot": "[] i32",
-        "noop_reply": "[] bool",
+        "act": "[] bool part=G",
+        "is_leader": "[] bool part=G",
+        "is_candidate": "[] bool part=G",
+        "is_follower_like": "[] bool part=G",
+        "sender_known": "[] bool part=G",
+        "sender_slot": "[] i32 part=G",
+        "noop_reply": "[] bool part=G",
     },
     "_Resp": {
-        "r_type": "[] i32",
-        "r_to": "[] i32",
-        "r_term": "[] i32",
-        "r_log_index": "[] i32",
-        "r_reject": "[] bool",
-        "r_hint": "[] i32",
-        "r_hint_high": "[] i32",
+        "r_type": "[] i32 part=G",
+        "r_to": "[] i32 part=G",
+        "r_term": "[] i32 part=G",
+        "r_log_index": "[] i32 part=G",
+        "r_reject": "[] bool part=G",
+        "r_hint": "[] i32 part=G",
+        "r_hint_high": "[] i32 part=G",
     },
 }
 

@@ -40,6 +40,19 @@ from dragonboat_tpu.core import params as P
 #                           `& (cap - 1)` (or argmax/arange-bounded to it)
 #           domain=A..B     values live in [params.A, params.B] inclusive
 #           optional        field is None unless the config materializes it
+#           part=G          the field carries PER-GROUP data: at the mesh
+#                           level its leading G axis is sharded over the
+#                           ('g','r') device mesh (parallel/ici.py) and no
+#                           kernel code may reduce/gather across G outside
+#                           a declared collective (analysis/partition.py)
+#           part=replicated the field is identical on every device (e.g.
+#                           fleet-stats aggregates); mixing it into
+#                           G-sharded math needs an explicit broadcast
+#           collective=declared
+#                           the struct's fields are produced by an
+#                           INTENTIONAL cross-G collective (core/fleet.py
+#                           FleetStats); cross-G reductions inside the
+#                           producing function are by design
 #
 # The contracts pass (scripts/lint.py --pass contracts) parses this dict
 # from the AST (it must stay a literal), abstractly interprets
@@ -52,127 +65,127 @@ from dragonboat_tpu.core import params as P
 CONTRACTS = {
     "ShardState": {
         # identity / config
-        "replica_id": "[G] i32",
-        "seed": "[G] i32",
-        "e_timeout": "[G] i32",
-        "h_timeout": "[G] i32",
-        "check_quorum": "[G] bool",
-        "pre_vote": "[G] bool",
+        "replica_id": "[G] i32 part=G",
+        "seed": "[G] i32 part=G",
+        "e_timeout": "[G] i32 part=G",
+        "h_timeout": "[G] i32 part=G",
+        "check_quorum": "[G] bool part=G",
+        "pre_vote": "[G] bool part=G",
         # core protocol state
-        "role": "[G] i32 domain=FOLLOWER..WITNESS",
-        "term": "[G] i32",
-        "vote": "[G] i32",
-        "leader": "[G] i32",
-        "applied": "[G] i32",
-        "e_tick": "[G] i32",
-        "h_tick": "[G] i32",
-        "rand_timeout": "[G] i32",
-        "rand_counter": "[G] i32",
-        "pending_cc": "[G] bool",
-        "ltt": "[G] i32",
-        "is_ltt": "[G] bool",
+        "role": "[G] i32 domain=FOLLOWER..WITNESS part=G",
+        "term": "[G] i32 part=G",
+        "vote": "[G] i32 part=G",
+        "leader": "[G] i32 part=G",
+        "applied": "[G] i32 part=G",
+        "e_tick": "[G] i32 part=G",
+        "h_tick": "[G] i32 part=G",
+        "rand_timeout": "[G] i32 part=G",
+        "rand_counter": "[G] i32 part=G",
+        "pending_cc": "[G] bool part=G",
+        "ltt": "[G] i32 part=G",
+        "is_ltt": "[G] bool part=G",
         # peer books
-        "pid": "[G, P] i32",
-        "kind": "[G, P] i32 domain=K_ABSENT..K_WITNESS",
-        "match": "[G, P] i32",
-        "next": "[G, P] i32",
-        "pstate": "[G, P] i32 domain=R_RETRY..R_SNAPSHOT",
-        "active": "[G, P] bool",
-        "psnap": "[G, P] i32",
-        "vresp": "[G, P] bool",
-        "vgrant": "[G, P] bool",
+        "pid": "[G, P] i32 part=G",
+        "kind": "[G, P] i32 domain=K_ABSENT..K_WITNESS part=G",
+        "match": "[G, P] i32 part=G",
+        "next": "[G, P] i32 part=G",
+        "pstate": "[G, P] i32 domain=R_RETRY..R_SNAPSHOT part=G",
+        "active": "[G, P] bool part=G",
+        "psnap": "[G, P] i32 part=G",
+        "vresp": "[G, P] bool part=G",
+        "vgrant": "[G, P] bool part=G",
         # log ring + cursors
-        "lt": "[G, CAP] i32 ring",
-        "lcc": "[G, CAP] bool ring",
-        "snap_index": "[G] i32",
-        "snap_term": "[G] i32",
-        "last": "[G] i32",
-        "committed": "[G] i32",
-        "processed": "[G] i32",
-        "stable": "[G] i32",
+        "lt": "[G, CAP] i32 ring part=G",
+        "lcc": "[G, CAP] bool ring part=G",
+        "snap_index": "[G] i32 part=G",
+        "snap_term": "[G] i32 part=G",
+        "last": "[G] i32 part=G",
+        "committed": "[G] i32 part=G",
+        "processed": "[G] i32 part=G",
+        "stable": "[G] i32 part=G",
         # ReadIndex circular book
-        "ri_low": "[G, RI] i32 ring",
-        "ri_high": "[G, RI] i32 ring",
-        "ri_index": "[G, RI] i32 ring",
-        "ri_acks": "[G, RI, P] bool ring",
-        "ri_head": "[G] i32",
-        "ri_count": "[G] i32",
-        "needs_host": "[G] bool",
-        "lv": "[G, CAP] i32 ring optional",
+        "ri_low": "[G, RI] i32 ring part=G",
+        "ri_high": "[G, RI] i32 ring part=G",
+        "ri_index": "[G, RI] i32 ring part=G",
+        "ri_acks": "[G, RI, P] bool ring part=G",
+        "ri_head": "[G] i32 part=G",
+        "ri_count": "[G] i32 part=G",
+        "needs_host": "[G] bool part=G",
+        "lv": "[G, CAP] i32 ring optional part=G",
     },
     "Inbox": {
-        "mtype": "[G, K] i32",
-        "from_": "[G, K] i32",
-        "term": "[G, K] i32",
-        "log_term": "[G, K] i32",
-        "log_index": "[G, K] i32",
-        "commit": "[G, K] i32",
-        "reject": "[G, K] bool",
-        "hint": "[G, K] i32",
-        "hint_high": "[G, K] i32",
-        "n_ent": "[G, K] i32",
-        "ent_term": "[G, K, E] i32",
-        "ent_cc": "[G, K, E] bool",
-        "ent_val": "[G, K, E] i32 optional",
+        "mtype": "[G, K] i32 part=G",
+        "from_": "[G, K] i32 part=G",
+        "term": "[G, K] i32 part=G",
+        "log_term": "[G, K] i32 part=G",
+        "log_index": "[G, K] i32 part=G",
+        "commit": "[G, K] i32 part=G",
+        "reject": "[G, K] bool part=G",
+        "hint": "[G, K] i32 part=G",
+        "hint_high": "[G, K] i32 part=G",
+        "n_ent": "[G, K] i32 part=G",
+        "ent_term": "[G, K, E] i32 part=G",
+        "ent_cc": "[G, K, E] bool part=G",
+        "ent_val": "[G, K, E] i32 optional part=G",
     },
     "StepInput": {
-        "prop_valid": "[G, B] bool",
-        "prop_cc": "[G, B] bool",
-        "ri_valid": "[G] bool",
-        "ri_low": "[G] i32",
-        "ri_high": "[G] i32",
-        "transfer_to": "[G] i32",
-        "tick": "[G] bool",
-        "quiesced": "[G] bool",
-        "applied": "[G] i32",
-        "prop_val": "[G, B] i32 optional",
+        "prop_valid": "[G, B] bool part=G",
+        "prop_cc": "[G, B] bool part=G",
+        "ri_valid": "[G] bool part=G",
+        "ri_low": "[G] i32 part=G",
+        "ri_high": "[G] i32 part=G",
+        "transfer_to": "[G] i32 part=G",
+        "tick": "[G] bool part=G",
+        "quiesced": "[G] bool part=G",
+        "applied": "[G] i32 part=G",
+        "prop_val": "[G, B] i32 optional part=G",
     },
     "StepOutput": {
-        "r_type": "[G, K] i32",
-        "r_to": "[G, K] i32",
-        "r_term": "[G, K] i32",
-        "r_log_index": "[G, K] i32",
-        "r_reject": "[G, K] bool",
-        "r_hint": "[G, K] i32",
-        "r_hint_high": "[G, K] i32",
-        "s_rep": "[G, P] bool",
-        "s_prev_index": "[G, P] i32",
-        "s_prev_term": "[G, P] i32",
-        "s_commit": "[G, P] i32",
-        "s_n_ent": "[G, P] i32",
-        "s_ent_term": "[G, P, E] i32",
-        "s_ent_cc": "[G, P, E] bool",
-        "s_ent_val": "[G, P, E] i32 optional",
-        "s_vote": "[G, P] i32",
-        "s_vote_term": "[G, P] i32",
-        "s_vote_lindex": "[G, P] i32",
-        "s_vote_lterm": "[G, P] i32",
-        "s_vote_hint": "[G, P] i32",
-        "s_hb": "[G, P] bool",
-        "s_hb_commit": "[G, P] i32",
-        "s_hb_low": "[G, P] i32",
-        "s_hb_high": "[G, P] i32",
-        "s_timeout_now": "[G, P] bool",
-        "s_need_snapshot": "[G, P] bool",
-        "s_wit_snap": "[G, P] bool",
-        "save_first": "[G] i32",
-        "save_last": "[G] i32",
-        "apply_first": "[G] i32",
-        "apply_last": "[G] i32",
-        "term": "[G] i32",
-        "vote": "[G] i32",
-        "commit": "[G] i32",
-        "rtr_valid": "[G, RI] bool",
-        "rtr_index": "[G, RI] i32",
-        "rtr_low": "[G, RI] i32",
-        "rtr_high": "[G, RI] i32",
-        "ri_dropped": "[G] bool",
-        "prop_accepted": "[G, B] bool",
-        "prop_index": "[G, B] i32",
-        "prop_term": "[G, B] i32",
-        "leader": "[G] i32",
-        "leader_term": "[G] i32",
-        "needs_host": "[G] bool",
+        "r_type": "[G, K] i32 part=G",
+        "r_to": "[G, K] i32 part=G",
+        "r_term": "[G, K] i32 part=G",
+        "r_log_index": "[G, K] i32 part=G",
+        "r_reject": "[G, K] bool part=G",
+        "r_hint": "[G, K] i32 part=G",
+        "r_hint_high": "[G, K] i32 part=G",
+        "s_rep": "[G, P] bool part=G",
+        "s_prev_index": "[G, P] i32 part=G",
+        "s_prev_term": "[G, P] i32 part=G",
+        "s_commit": "[G, P] i32 part=G",
+        "s_n_ent": "[G, P] i32 part=G",
+        "s_ent_term": "[G, P, E] i32 part=G",
+        "s_ent_cc": "[G, P, E] bool part=G",
+        "s_ent_val": "[G, P, E] i32 optional part=G",
+        "s_vote": "[G, P] i32 part=G",
+        "s_vote_term": "[G, P] i32 part=G",
+        "s_vote_lindex": "[G, P] i32 part=G",
+        "s_vote_lterm": "[G, P] i32 part=G",
+        "s_vote_hint": "[G, P] i32 part=G",
+        "s_hb": "[G, P] bool part=G",
+        "s_hb_commit": "[G, P] i32 part=G",
+        "s_hb_low": "[G, P] i32 part=G",
+        "s_hb_high": "[G, P] i32 part=G",
+        "s_timeout_now": "[G, P] bool part=G",
+        "s_need_snapshot": "[G, P] bool part=G",
+        "s_wit_snap": "[G, P] bool part=G",
+        "save_first": "[G] i32 part=G",
+        "save_last": "[G] i32 part=G",
+        "apply_first": "[G] i32 part=G",
+        "apply_last": "[G] i32 part=G",
+        "term": "[G] i32 part=G",
+        "vote": "[G] i32 part=G",
+        "commit": "[G] i32 part=G",
+        "rtr_valid": "[G, RI] bool part=G",
+        "rtr_index": "[G, RI] i32 part=G",
+        "rtr_low": "[G, RI] i32 part=G",
+        "rtr_high": "[G, RI] i32 part=G",
+        "ri_dropped": "[G] bool part=G",
+        "prop_accepted": "[G, B] bool part=G",
+        "prop_index": "[G, B] i32 part=G",
+        "prop_term": "[G, B] i32 part=G",
+        "leader": "[G] i32 part=G",
+        "leader_term": "[G] i32 part=G",
+        "needs_host": "[G] bool part=G",
     },
 }
 
@@ -200,6 +213,13 @@ DONATION = {
     "step_donated": {
         "argnums": (1, 2, 3),
         "params": ("state", "inbox", "inp"),
+        # partition identity of the donation (analysis/partition.py,
+        # PS004): XLA reuses donor memory for results, which is only
+        # sound if donor and result live under the SAME sharding.  Every
+        # donor class must share its declared partition with at least one
+        # result class.
+        "donor_classes": ("ShardState", "Inbox", "StepInput"),
+        "result_classes": ("ShardState", "StepOutput"),
     },
 }
 

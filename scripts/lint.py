@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis runner: the five lint passes over the repo.
+"""Static-analysis runner: the six lint passes over the repo.
 
 Passes (dragonboat_tpu/analysis/):
 
@@ -17,13 +17,26 @@ Passes (dragonboat_tpu/analysis/):
                   interpretation of core/kernel.py against the
                   CONTRACTS declarations, plus an eval_shape diff of
                   declared vs actual structures)
+  partition       SPMD partition safety for the G axis: cross-group
+                  data flow outside declared collectives, shard_map
+                  in/out_specs vs the part= contract tags, donation
+                  sharding identity, host callbacks inside shard_map
+                  bodies, implicit device→host syncs in the engine hot
+                  paths, and a 2-device dynamic diff of declared vs
+                  actual output shardings
+
+Passes run in parallel worker processes (one fork per pass; jax
+initializes per-child so the AST-only passes never pay for it).  Use
+`--jobs 1` to force the serial path, `--changed-only` to run only the
+passes whose input files differ from git HEAD (the tight-edit-loop
+mode; scripts/run_tests.sh lint-fast wraps it).
 
 Exit status is non-zero iff any unwaived finding remains.  Waivers live
 in dragonboat_tpu/analysis/waivers.toml; waived findings are still
 printed (with their reasons) so suppressions stay visible.  On a full
-run (no --pass filter) the waivers themselves are linted: an entry
-whose path pattern matches no file (SW001) or that suppressed zero
-findings (SW002) is stale and fails the run.
+run (no --pass filter, no --changed-only) the waivers themselves are
+linted: an entry whose path pattern matches no file (SW001) or that
+suppressed zero findings (SW002) is stale and fails the run.
 
 `--format json` emits one finding per line (JSON object with path,
 line, pass, rule, message, waived, reason) so CI can diff findings
@@ -34,7 +47,8 @@ a hashed kernel source changed since the cached measurement
 (analysis/.hlo_budget_cache.json); skip it entirely during tight edit
 loops with `--pass` selecting the AST passes, or refresh its budget
 after a justified kernel change with `--reseed-hlo-budget` (then
-record why in PERF.md).
+record why in PERF.md).  The partition pass's dynamic mesh check
+caches the same way (analysis/.partition_cache.json).
 """
 
 from __future__ import annotations
@@ -43,10 +57,17 @@ import argparse
 import fnmatch
 import json
 import os
+import subprocess
 import sys
 
 # lowering must never grab a TPU just to count ops
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the partition pass's dynamic check needs a 2-device mesh; the flag
+# must be set before any child (or this process) initializes jax
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=2").strip()
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -57,6 +78,7 @@ from dragonboat_tpu.analysis import (  # noqa: E402
     contracts,
     determinism,
     hlo_budget,
+    partition,
     tracer_safety,
 )
 
@@ -66,6 +88,18 @@ PASSES = {
     "determinism": determinism.run,
     "hlo-budget": hlo_budget.run,
     "contracts": contracts.run,
+    "partition": partition.run,
+}
+
+# repo-relative inputs of each pass, for --changed-only (entries may be
+# fnmatch globs — determinism scopes whole directories)
+PASS_SCOPES = {
+    "tracer-safety": tracer_safety.DEFAULT_MODULES,
+    "concurrency": concurrency.DEFAULT_MODULES,
+    "determinism": determinism.DEFAULT_GLOBS,
+    "hlo-budget": hlo_budget.CACHE_SOURCES,
+    "contracts": contracts.CONTRACT_FILES + (contracts.PARAMS_FILE,),
+    "partition": partition.SCOPE,
 }
 
 WAIVERS_FILE = "dragonboat_tpu/analysis/waivers.toml"
@@ -86,9 +120,9 @@ def stale_waiver_findings(waivers: list[common.Waiver],
                           root: str) -> list[common.Finding]:
     """SW001/SW002: waivers that outlived the code they excused.
 
-    Only meaningful after a FULL run — a --pass subset legitimately
-    leaves other passes' waivers unexercised — so the caller gates on
-    that.
+    Only meaningful after a FULL run — a --pass / --changed-only subset
+    legitimately leaves other passes' waivers unexercised — so the
+    caller gates on that.
     """
     relpath = common.rel(root, os.path.join(root, WAIVERS_FILE))
     files = _repo_rel_files(root)
@@ -108,11 +142,87 @@ def stale_waiver_findings(waivers: list[common.Waiver],
     return findings
 
 
+def changed_files(root: str) -> list[str] | None:
+    """Repo-relative changed paths vs HEAD (staged + unstaged +
+    untracked), or None when git is unavailable (callers run
+    everything)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0:
+        return None
+    out = [ln.strip() for ln in diff.stdout.splitlines() if ln.strip()]
+    if untracked.returncode == 0:
+        out += [ln.strip() for ln in untracked.stdout.splitlines()
+                if ln.strip()]
+    return sorted(set(out))
+
+
+def select_changed(changed: list[str]) -> list[str]:
+    """Which passes a change set touches.  Any edit to the analyzers or
+    this runner invalidates everything."""
+    if any(c.startswith("dragonboat_tpu/analysis/")
+           or c.startswith("scripts/lint") for c in changed):
+        return sorted(PASSES)
+    out = []
+    for name in sorted(PASSES):
+        scope = PASS_SCOPES[name]
+        if any(fnmatch.fnmatch(c, pat) or c == pat
+               for c in changed for pat in scope):
+            out.append(name)
+    return out
+
+
+def _run_pass(name: str) -> list[common.Finding]:
+    """Worker entry: one pass, raw (unwaived) findings.  Waivers are
+    applied in the parent so hit-counting (stale-waiver lint) sees every
+    pass's results."""
+    return PASSES[name](ROOT)
+
+
+def run_passes(selected: list[str],
+               jobs: int) -> dict[str, list[common.Finding]]:
+    """Run passes, in parallel when possible; results keyed by pass."""
+    if jobs != 1 and len(selected) > 1:
+        try:
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            nworkers = min(len(selected),
+                           jobs if jobs > 0 else (os.cpu_count() or 2))
+            # fork so workers inherit the imported analyzers; jax is
+            # only ever initialized inside a child
+            with ProcessPoolExecutor(
+                    max_workers=nworkers,
+                    mp_context=multiprocessing.get_context("fork"),
+            ) as pool:
+                futs = {name: pool.submit(_run_pass, name)
+                        for name in selected}
+                return {name: fut.result() for name, fut in futs.items()}
+        except Exception as e:  # no fork/semaphores: degrade, don't fail
+            print(f"note: parallel pass execution unavailable "
+                  f"({type(e).__name__}: {e}); running serially",
+                  file=sys.stderr)
+    return {name: _run_pass(name) for name in selected}
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=sorted(PASSES),
                     help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="run only passes whose input files changed vs "
+                         "git HEAD (skips the stale-waiver lint)")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="worker processes (0 = one per pass up to CPU "
+                         "count; 1 = serial)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings blob on stdout "
                          "(legacy; prefer --format json)")
@@ -138,12 +248,23 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     selected = args.passes or sorted(PASSES)
+    skipped: list[str] = []
+    if args.changed_only:
+        changed = changed_files(ROOT)
+        if changed is not None:
+            wanted = select_changed(changed)
+            skipped = [n for n in selected if n not in wanted]
+            selected = [n for n in selected if n in wanted]
     human = args.format == "human" and not args.json
+    if human and skipped:
+        print(f"-- changed-only: skipping {', '.join(skipped)} "
+              "(inputs unchanged)")
+
+    results = run_passes(selected, args.jobs)
     unwaived: list[common.Finding] = []
     waived: list[tuple[common.Finding, common.Waiver]] = []
     for name in selected:
-        findings = PASSES[name](ROOT)
-        u, w = common.apply_waivers(findings, waivers)
+        u, w = common.apply_waivers(results[name], waivers)
         unwaived += u
         waived += w
         if human:
@@ -153,7 +274,7 @@ def main(argv: list[str] | None = None) -> int:
             for f, wv in w:
                 print(f"  [waived: {wv.reason}] {f.format()}")
 
-    if args.passes is None:
+    if args.passes is None and not args.changed_only:
         # full run: a waiver that excuses nothing is itself a finding
         # (not waivable — a waiver cannot excuse its own staleness)
         stale = stale_waiver_findings(waivers, ROOT)

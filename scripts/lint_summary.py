@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Render a per-check summary table from a lint findings artifact.
+
+Input: the file produced by ``scripts/lint.py --format json`` (one
+JSON object per line: path, line, pass, rule, message, waived, reason).
+scripts/run_tests.sh writes it to ``build/lint_findings.jsonl`` (or
+``$LINT_ARTIFACT``) so CI can upload it and diff findings between
+commits, then runs this to fail the build with a readable breakdown
+instead of a raw JSON wall.
+
+Exit status: 1 iff any unwaived finding is present, 2 on a malformed
+artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def summarize(lines: list[str]) -> tuple[str, int]:
+    """-> (report text, number of unwaived findings)."""
+    rows: list[dict] = []
+    for n, ln in enumerate(lines, 1):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            row = json.loads(ln)
+        except ValueError as e:
+            raise ValueError(f"line {n}: not JSON ({e})") from e
+        if not isinstance(row, dict) or "rule" not in row:
+            raise ValueError(f"line {n}: not a finding object")
+        rows.append(row)
+
+    counts: dict[tuple[str, str], list[int]] = {}
+    for r in rows:
+        c = counts.setdefault((str(r.get("pass")), str(r["rule"])), [0, 0])
+        c[1 if r.get("waived") else 0] += 1
+
+    out: list[str] = []
+    unwaived = [r for r in rows if not r.get("waived")]
+    for r in unwaived:
+        out.append(f"  {r.get('path')}:{r.get('line')}: "
+                   f"[{r['rule']}] {r.get('message')}")
+    if out:
+        out.append("")
+    header = f"{'pass':<14} {'check':<7} {'unwaived':>8} {'waived':>7}"
+    out.append(header)
+    out.append("-" * len(header))
+    for (pname, rule), (u, w) in sorted(counts.items()):
+        out.append(f"{pname:<14} {rule:<7} {u:>8} {w:>7}")
+    if not counts:
+        out.append("(no findings)")
+    total_u = len(unwaived)
+    total_w = len(rows) - total_u
+    out.append("-" * len(header))
+    status = "FAIL" if total_u else "OK"
+    out.append(f"{status}: {total_u} unwaived, {total_w} waived")
+    return "\n".join(out), total_u
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print("usage: lint_summary.py <findings.jsonl>", file=sys.stderr)
+        return 2
+    try:
+        with open(argv[1], encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        report, unwaived = summarize(lines)
+    except ValueError as e:
+        print(f"error: malformed artifact: {e}", file=sys.stderr)
+        return 2
+    print(report)
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

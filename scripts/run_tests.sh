@@ -25,15 +25,41 @@ run() {
     echo "== chunk: $* =="
     PYTHONPATH= "$PY" -m pytest "$@" -q || rc=$?
 }
-# fast pre-test stage: the five static-analysis passes (scripts/lint.py;
+# Lint findings are written as a JSON-lines build artifact (CI uploads
+# it; diffable between commits) and rendered as a per-check summary
+# table by scripts/lint_summary.py, which carries the pass/fail.
+ARTIFACT="${LINT_ARTIFACT:-build/lint_findings.jsonl}"
+mkdir -p "$(dirname "$ARTIFACT")"
+lint() {
+    # $@ = extra scripts/lint.py args; rc 2+ (waiver/parse errors) must
+    # not be masked by an empty artifact looking clean
+    lint_rc=0
+    PYTHONPATH= "$PY" scripts/lint.py --format json "$@" \
+        > "$ARTIFACT" || lint_rc=$?
+    if [ "$lint_rc" -ge 2 ]; then
+        echo "lint runner error (rc=$lint_rc)"
+        return "$lint_rc"
+    fi
+    PYTHONPATH= "$PY" scripts/lint_summary.py "$ARTIFACT"
+}
+# `run_tests.sh lint-fast`: the tight-edit-loop entry — only the lint
+# passes whose input files changed vs git HEAD, then exit
+if [ "${1:-}" = "lint-fast" ]; then
+    echo "== lint (changed-only) =="
+    lint --changed-only
+    exit $?
+fi
+# fast pre-test stage: the six static-analysis passes (scripts/lint.py;
 # ~2 s when kernel sources are unchanged — the hlo-budget compile result
-# is cached in analysis/.hlo_budget_cache.json keyed by a source hash —
-# and ~12 s after a kernel edit).  After a justified kernel change that
-# shifts the gather/scatter/while counts:
-# `python scripts/lint.py --reseed-hlo-budget`, review the
-# analysis/hlo_budget.json diff, and record why in PERF.md.
+# is cached in analysis/.hlo_budget_cache.json keyed by a source hash,
+# and the partition pass's 2-device mesh check likewise in
+# analysis/.partition_cache.json — and ~12 s after a kernel edit).
+# After a justified kernel change that shifts the
+# gather/scatter/while counts: `python scripts/lint.py
+# --reseed-hlo-budget`, review the analysis/hlo_budget.json diff, and
+# record why in PERF.md.
 echo "== lint =="
-PYTHONPATH= "$PY" scripts/lint.py || rc=$?
+lint || rc=$?
 run tests/test_zz_kernel_scale.py tests/test_zz_mesh_scale.py
 run tests/test_a*.py tests/test_b*.py tests/test_d*.py tests/test_e*.py \
     tests/test_f*.py tests/test_g*.py tests/test_h*.py tests/test_k*.py

@@ -565,7 +565,7 @@ def test_contracts_runtime_flags_declared_vs_actual_mismatch(tmp_path):
     seg = next(ast.get_source_segment(src, n) for n in tree.body
                if isinstance(n, ast.Assign)
                and getattr(n.targets[0], "id", None) == "CONTRACTS")
-    good = '"role": "[G] i32 domain=FOLLOWER..WITNESS"'
+    good = '"role": "[G] i32 domain=FOLLOWER..WITNESS part=G"'
     assert good in seg
     tampered = seg.replace(good, '"role": "[G, P] i32"')
     d = tmp_path / "dragonboat_tpu" / "core"

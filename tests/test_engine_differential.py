@@ -1,0 +1,159 @@
+"""Engine-equivalence differential: the two engines' kernel dispatch
+paths are the same state machine, bit for bit, under a randomized
+schedule.
+
+KernelEngine._kernel_call drives the router-layout kernel
+(core/router.cluster_step: step + host-shaped routing); MeshEngine
+._kernel_call drives ici_serve_step (parallel/ici.py: step + device
+psum routing under shard_map on a (g, r) mesh).  Everything above that
+seam — staging, retirement, node bookkeeping — is shared KernelEngine
+code, so this is the exact point where the two engines can diverge.
+
+tests/test_mesh_differential.py pins the seam under the deterministic
+self-driving schedule.  This file pins it under an ADVERSARIAL one: 300
+micro-steps of randomized leader-masked proposals and randomized ticks
+(missed ticks reorder election timeouts; bursty proposals exercise
+batch-full paths), generated once per step in router layout and
+permuted onto the mesh rows, so both paths consume identical inputs.
+After every step the mesh ShardState — permuted back to the router's
+group-major layout — must equal the router state bitwise, and the
+mesh's device-side pending count must equal the router inbox's
+occupancy.  Runs on the forced multi-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8); skips when fewer than 2
+devices are available.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.router import cluster_step
+from dragonboat_tpu.parallel.ici import (
+    ici_serve_step,
+    make_ici_cluster,
+)
+from dragonboat_tpu.core.kstate import StepInput
+
+STEPS = 300
+G_SIZE, REPLICAS, N_LOCAL = 1, 2, 4  # 8 rows on 2 mesh devices
+
+
+def _kp(replicas: int) -> KP.KernelParams:
+    return KP.KernelParams(
+        num_peers=replicas,
+        log_cap=64,
+        inbox_cap=5 * max(1, replicas - 1),
+        msg_entries=4,
+        proposal_cap=4,
+        readindex_cap=4,
+        apply_batch=16,
+        compaction_overhead=16,
+    )
+
+
+def _mesh(g_size: int, replicas: int) -> Mesh:
+    devs = jax.devices()
+    need = g_size * replicas
+    if len(devs) < need:
+        pytest.skip(f"needs {need} devices")
+    return Mesh(np.array(devs[:need]).reshape(g_size, replicas), ("g", "r"))
+
+
+def _perm(g_size: int, replicas: int, n_local: int) -> np.ndarray:
+    """perm[router_row] = mesh_row for the same (group, replica)."""
+    N = g_size * n_local
+    perm = np.empty(N * replicas, np.int64)
+    for g in range(N):
+        ig, n = divmod(g, n_local)
+        for ir in range(replicas):
+            perm[g * replicas + ir] = (ig * replicas + ir) * n_local + n
+    return perm
+
+
+def _pull(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _permute(tree, perm):
+    return jax.tree.map(lambda x: x[perm], tree)
+
+
+def _assert_equal(tag, a, b):
+    for f, xa, xb in zip(type(a)._fields, a, b):
+        if xa is None and xb is None:
+            continue
+        assert np.array_equal(np.asarray(xa), np.asarray(xb)), (
+            f"{tag}: field {f} diverged")
+
+
+def _random_input(kp: KP.KernelParams, rng: np.random.Generator,
+                  state_np, layout_perm: np.ndarray | None) -> StepInput:
+    """One randomized step input, derived from ROUTER-ROW randomness.
+
+    The raw draws are indexed by router row; ``layout_perm`` (the
+    inverse row permutation, or None for the router side) re-lands them
+    on the mesh rows so both paths see the same (group, replica)
+    schedule.  Leader masking and the applied cursor come from the
+    caller's own state, which the lockstep invariant keeps bitwise
+    equal across layouts.
+    """
+    G, B = state_np.term.shape[0], kp.proposal_cap
+    pv = rng.random((G, B)) < 0.5
+    tick = rng.random(G) < 0.9
+    if layout_perm is not None:
+        pv, tick = pv[layout_perm], tick[layout_perm]
+    is_leader = np.asarray(state_np.role) == KP.LEADER
+    z = lambda: np.zeros((G,), np.int32)  # noqa: E731
+    return StepInput(
+        prop_valid=pv & is_leader[:, None],
+        prop_cc=np.zeros((G, B), bool),
+        ri_valid=np.zeros((G,), bool),
+        ri_low=z(),
+        ri_high=z(),
+        transfer_to=z(),
+        tick=tick,
+        quiesced=np.zeros((G,), bool),
+        applied=np.asarray(state_np.processed),
+    )
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_engine_kernel_paths_bitwise_equal(seed):
+    """300 randomized micro-steps, lockstep, bitwise-identical state."""
+    kp = _kp(REPLICAS)
+    mesh = _mesh(G_SIZE, REPLICAS)
+    cluster, state_m, box_m = make_ici_cluster(
+        kp, mesh, num_groups=G_SIZE * N_LOCAL)
+    perm = _perm(G_SIZE, REPLICAS, N_LOCAL)
+    iperm = np.argsort(perm)  # mesh_row -> router_row source index
+    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+
+    # identical starting state, router layout
+    state_r = _permute(_pull(state_m), perm)
+    box_r = _permute(_pull(box_m), perm)
+
+    # one generator; each step draws router-layout randomness that both
+    # paths consume (mesh side via iperm), so the schedules are identical
+    rng = np.random.default_rng(seed)
+    committed = 0
+    for step_no in range(STEPS):
+        draws = rng.bit_generator.state  # rewind point: same draws twice
+        inp_r = _random_input(kp, rng, _pull(state_r), None)
+        rng.bit_generator.state = draws
+        inp_m = _random_input(kp, rng, _pull(state_m), iperm)
+
+        state_m, box_m, _, pending = ici_serve_step(
+            cluster, state_m, box_m, cluster.shard(inp_m), cut)
+        state_r, box_r, _ = cluster_step(kp, REPLICAS, state_r, box_r, inp_r)
+
+        _assert_equal(f"seed {seed} step {step_no} state",
+                      _permute(_pull(state_m), perm), _pull(state_r))
+        _assert_equal(f"seed {seed} step {step_no} box",
+                      _permute(_pull(box_m), perm), _pull(box_r))
+        # the mesh's device-side pending count is the router occupancy
+        assert int(pending) == int((np.asarray(box_r.mtype) != 0).sum()), (
+            f"seed {seed} step {step_no}: pending diverged")
+        committed = int(np.asarray(state_r.committed).max())
+    assert committed > 0, "randomized differential ran but never committed"

@@ -1,0 +1,335 @@
+"""Partition-safety pass (dragonboat_tpu/analysis/partition.py): every
+PS001-PS006 defect class must fire on a known-bad fixture, the licensed
+spellings of the same patterns must come back clean, the repo itself
+must be clean both statically and under the 2-device dynamic sharding
+diff, and the mesh-check / hlo-budget caches must invalidate on source
+or jax-version changes."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import textwrap
+
+import pytest
+
+from dragonboat_tpu.analysis import common, hlo_budget, partition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "lint_under_test", os.path.join(REPO, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _run_fixture(tmp_path, src):
+    p = _write(tmp_path, "fix.py", src)
+    return partition.run(str(tmp_path), files=[p], dynamic=False)
+
+
+# ------------------------------------------------------- contract grammar
+
+def test_part_and_collective_tags_parse():
+    fc = common.parse_contract("[G] i32 part=G")
+    assert fc.part == "G" and fc.collective is None
+    fc = common.parse_contract("[] i32 part=replicated collective=declared")
+    assert fc.part == "replicated" and fc.collective == "declared"
+    fc = common.parse_contract("[G, K] i32 ring collective=none")
+    assert fc.collective == "none" and fc.part is None
+
+
+def test_bad_part_and_collective_values_raise():
+    with pytest.raises(common.ContractError, match="part"):
+        common.parse_contract("[G] i32 part=R")
+    with pytest.raises(common.ContractError, match="collective"):
+        common.parse_contract("[G] i32 collective=psum")
+    # the unknown-tag diagnostic is not shadowed by the new tags
+    with pytest.raises(common.ContractError, match="tag"):
+        common.parse_contract("[G] i32 wat")
+
+
+# ------------------------------------------------- PS001 cross-G reduction
+
+PS001_BAD = """\
+    CONTRACTS = {"ShardState": {"term": "[G] i32 part=G"}}
+
+    def bad_total(state: ShardState):
+        return state.term.sum()
+"""
+
+PS001_DECLARED = """\
+    CONTRACTS = {
+        "ShardState": {"term": "[G] i32 part=G"},
+        "Stats": {"total": "[] i32 part=replicated collective=declared"},
+    }
+
+    def ok_total(state: ShardState):
+        return Stats(total=state.term.sum())
+"""
+
+
+def test_ps001_cross_g_reduction_fires(tmp_path):
+    findings = _run_fixture(tmp_path, PS001_BAD)
+    assert [f.rule for f in findings] == ["PS001"]
+    assert "G" in findings[0].message
+
+
+def test_ps001_declared_collective_result_is_licensed(tmp_path):
+    assert _run_fixture(tmp_path, PS001_DECLARED) == []
+
+
+# ------------------------------------------------- PS002 shard_map specs
+
+PS002_BAD = """\
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    CONTRACTS = {"ShardState": {"term": "[G] i32 part=G"}}
+
+    def body(state: ShardState):
+        return state
+
+    def bad_specs(mesh, state):
+        f = jax.shard_map(body, mesh=mesh, in_specs=(PS(),),
+                          out_specs=(PS(),))
+        return f(state)
+"""
+
+PS002_OK = """\
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    CONTRACTS = {"ShardState": {"term": "[G] i32 part=G"}}
+
+    def body(state: ShardState):
+        return state
+
+    def ok_specs(mesh, state):
+        f = jax.shard_map(body, mesh=mesh, in_specs=(PS(("g", "r")),),
+                          out_specs=(PS(("g", "r")),))
+        return f(state)
+"""
+
+
+def test_ps002_unsharded_specs_for_g_part_fire(tmp_path):
+    findings = _run_fixture(tmp_path, PS002_BAD)
+    rules = [f.rule for f in findings]
+    assert rules == ["PS002", "PS002"]  # in_specs and out_specs
+
+
+def test_ps002_g_axis_specs_are_clean(tmp_path):
+    assert _run_fixture(tmp_path, PS002_OK) == []
+
+
+# --------------------------------------- PS003 replicated x sharded mixes
+
+PS003_BAD = """\
+    import jax
+
+    CONTRACTS = {"ShardState": {"term": "[G] i32 part=G"}}
+
+    def bad_mix(state: ShardState):
+        total = jax.lax.psum(state.term, ("g", "r"))
+        return state.term + total
+"""
+
+PS003_OK = """\
+    import jax
+    import jax.numpy as jnp
+
+    CONTRACTS = {"ShardState": {"term": "[G] i32 part=G"}}
+
+    def ok_mix(state: ShardState):
+        total = jax.lax.psum(state.term, ("g", "r"))
+        return state.term + jnp.broadcast_to(total, state.term.shape)
+"""
+
+
+def test_ps003_unannotated_replicated_mix_fires(tmp_path):
+    findings = _run_fixture(tmp_path, PS003_BAD)
+    assert [f.rule for f in findings] == ["PS003"]
+
+
+def test_ps003_broadcast_annotation_is_clean(tmp_path):
+    assert _run_fixture(tmp_path, PS003_OK) == []
+
+
+# ------------------------------------------- PS004 donation sharding identity
+
+PS004_BAD = """\
+    CONTRACTS = {
+        "ShardState": {"term": "[G] i32 part=G"},
+        "Stats": {"total": "[] i32 part=replicated"},
+    }
+
+    DONATION = {
+        "step_donated": {
+            "argnums": (0,),
+            "params": ("state",),
+            "donor_classes": ("ShardState",),
+            "result_classes": ("Stats",),
+        },
+    }
+"""
+
+
+def test_ps004_donor_partition_missing_from_results_fires(tmp_path):
+    findings = _run_fixture(tmp_path, PS004_BAD)
+    assert [f.rule for f in findings] == ["PS004"]
+    assert "ShardState" in findings[0].message
+
+
+# --------------------------------------- PS005 callbacks inside shard_map
+
+PS005_BAD = """\
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    def cb_body(x):
+        return jax.pure_callback(int, x, x)
+
+    def run_cb(mesh, x):
+        return jax.shard_map(cb_body, mesh=mesh, in_specs=PS(),
+                             out_specs=PS())(x)
+"""
+
+
+def test_ps005_callback_in_shard_map_body_fires(tmp_path):
+    findings = _run_fixture(tmp_path, PS005_BAD)
+    assert [f.rule for f in findings] == ["PS005"]
+    assert "pure_callback" in findings[0].message
+
+
+# --------------------------------------- PS006 host syncs in hot paths
+
+PS006_BAD = """\
+    class Eng:
+        def step_all(self):
+            state, out = self._kernel_call(None, None)
+            return int(state.term[0])
+"""
+
+PS006_OK = """\
+    class Eng:
+        def step_all(self):
+            state, out = self._kernel_call(None, None)
+            self.state = state
+            return out
+"""
+
+
+def test_ps006_host_sync_in_hot_path_fires(tmp_path):
+    findings = _run_fixture(tmp_path, PS006_BAD)
+    assert [f.rule for f in findings] == ["PS006"]
+
+
+def test_ps006_device_resident_hot_path_is_clean(tmp_path):
+    assert _run_fixture(tmp_path, PS006_OK) == []
+
+
+# ---------------------------------------------------------- repo is clean
+
+def test_repo_static_partition_clean():
+    assert partition.run(REPO, dynamic=False) == []
+
+
+def test_repo_dynamic_sharding_clean_and_cached(tmp_path):
+    findings = partition.sharding_check(REPO)
+    assert findings == []
+    cache = os.path.join(REPO, partition.CACHE_FILE)
+    assert os.path.exists(cache)
+    with open(cache, encoding="utf-8") as f:
+        blob = json.load(f)
+    assert blob["source_hash"] == partition._source_key(REPO)
+
+
+def test_dynamic_check_catches_tampered_declaration():
+    findings = partition.sharding_check(
+        REPO, parts_override={("ShardState", "term"): "replicated"})
+    assert findings, "tampered part= declaration went undetected"
+    assert any("ShardState.term" in f.message for f in findings)
+    assert all(f.rule == "PS002" for f in findings)
+
+
+def test_partition_cache_rejects_stale_key(tmp_path):
+    path = str(tmp_path / "cache.json")
+    partition._cache_save(
+        path, "key-a",
+        [common.Finding("partition", "x.py", 1, "PS002", "m")])
+    hit = partition._cache_load(path, "key-a")
+    assert hit is not None and hit[0].rule == "PS002"
+    assert partition._cache_load(path, "key-b") is None
+
+
+# --------------------------------------------- hlo-budget cache keying
+
+def test_hlo_cache_invalidates_on_jax_version_bump(tmp_path, monkeypatch):
+    import jax
+
+    key_now = hlo_budget.source_hash(REPO)
+    monkeypatch.setattr(jax, "__version__", "0.0.0-test", raising=False)
+    key_bumped = hlo_budget.source_hash(REPO)
+    assert key_now != key_bumped
+
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "dragonboat_tpu", "analysis"))
+    hlo_budget._cache_store(root, key_now, {"run_steps": {"gather": 1}})
+    assert hlo_budget._cache_load(root, key_now) == {
+        "run_steps": {"gather": 1}}
+    # the same cache under the bumped compiler version must miss
+    assert hlo_budget._cache_load(root, key_bumped) is None
+
+
+# --------------------------------------------- lint runner integration
+
+def test_lint_registers_partition_pass_and_scopes():
+    mod = _load_lint_module()
+    assert "partition" in mod.PASSES
+    assert "dragonboat_tpu/parallel/ici.py" in mod.PASS_SCOPES["partition"]
+
+
+def test_changed_only_selection():
+    mod = _load_lint_module()
+    assert "partition" in mod.select_changed(
+        ["dragonboat_tpu/parallel/ici.py"])
+    assert mod.select_changed(["README.md"]) == []
+    # analyzer edits invalidate every pass
+    assert mod.select_changed(
+        ["dragonboat_tpu/analysis/partition.py"]) == sorted(mod.PASSES)
+
+
+def test_lint_summary_table_and_exit():
+    spec = importlib.util.spec_from_file_location(
+        "lint_summary_under_test",
+        os.path.join(REPO, "scripts", "lint_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rows = [
+        json.dumps({"path": "a.py", "line": 3, "pass": "partition",
+                    "rule": "PS001", "message": "boom", "waived": False,
+                    "reason": None}),
+        json.dumps({"path": "b.py", "line": 9, "pass": "contracts",
+                    "rule": "KC001", "message": "ok", "waived": True,
+                    "reason": "why"}),
+    ]
+    report, unwaived = mod.summarize(rows)
+    assert unwaived == 1
+    assert "PS001" in report and "FAIL: 1 unwaived, 1 waived" in report
+
+    report, unwaived = mod.summarize([])
+    assert unwaived == 0 and "no findings" in report
+
+    with pytest.raises(ValueError, match="not JSON"):
+        mod.summarize(["{nope"])
