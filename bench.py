@@ -46,7 +46,10 @@ fused rsm-apply kernel, rsm/device_kv.py), BENCH_PALLAS=1 (with
 BENCH_DEVICE_SM: route the apply through the pallas block kernel,
 rsm/device_kv_pallas.py), BENCH_TELEMETRY=1 (standalone mode: A-B
 overhead of the device-side fleet_stats telemetry reduction at the
-engine's decimation cadence — see run_telemetry_ab), BENCH_PIPELINE=1
+engine's decimation cadence — see run_telemetry_ab), BENCH_HEALTH=1
+(standalone mode: interleaved A-B overhead of the fleet_health anomaly
+pass + O(K) report fetch on top of the fleet_stats baseline — see
+run_health_ab), BENCH_PIPELINE=1
 (standalone mode: interleaved A-B of the serial vs fused depth-1
 pipelined step loops with commit-latency percentiles per arm — see
 run_pipeline_ab), BENCH_TRACE=1 (standalone mode: interleaved A-B
@@ -1040,6 +1043,88 @@ def run_telemetry_ab() -> None:
     })
 
 
+def run_health_ab() -> None:
+    """BENCH_HEALTH=1: interleaved A-B overhead of the device-side
+    fleet_health pass (core/health.py) on top of the fleet_stats
+    baseline, at the engine's decimation cadence.
+
+    Arm A is the pre-health production path: the bench loop in
+    ``every``-step launches plus one fleet_stats call + fetch per launch.
+    Arm B adds exactly what KernelEngine._collect_health adds — one
+    jitted ``fleet_health`` call carrying the HealthDigest between
+    launches, plus its O(K) report fetch.  Arms interleave A,B,A,B,...
+    (median-of-3 per arm) so box drift lands on both.  Knobs:
+    BENCH_HEALTH_GROUPS (default 10000), BENCH_HEALTH_STEPS (120),
+    BENCH_HEALTH_EVERY (10)."""
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core import fleet, health
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_HEALTH_GROUPS", "10000"))
+    steps = int(os.environ.get("BENCH_HEALTH_STEPS", "120"))
+    every = max(1, int(os.environ.get("BENCH_HEALTH_EVERY", "10")))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    state, box = elect_all(kp, replicas, state)
+    num_lanes = int(state.term.shape[0])
+    digest = health.empty_digest(num_lanes)
+
+    def window(with_health: bool) -> float:
+        nonlocal state, box, digest
+        t0 = time.time()
+        done = 0
+        while done < steps:
+            state, box = run_steps(kp, replicas, every, True, True,
+                                   state, box)
+            done += every
+            fleet.stats_to_dict(fleet.fleet_stats(state, box.from_))
+            if with_health:
+                report, digest = health.fleet_health(state, box.from_,
+                                                     digest)
+                health.report_to_dict(report)
+        state.term.block_until_ready()
+        return time.time() - t0
+
+    # warm all executables (run_steps, fleet_stats, fleet_health)
+    # outside the timed windows
+    window(True)
+    a_walls, b_walls = [], []
+    for _ in range(3):
+        a_walls.append(window(False))
+        b_walls.append(window(True))
+    a = sorted(a_walls)[1]
+    b = sorted(b_walls)[1]
+    overhead_pct = (b - a) / a * 100.0
+    emit({
+        "metric": (f"fleet_health step-latency overhead, {g} groups x "
+                   f"{replicas} replicas, decimation N={every}"),
+        "value": round(overhead_pct, 2),
+        "unit": "% vs fleet_stats-only step",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "replicas": replicas,
+            "steps_per_arm_window": steps,
+            "decimation_every": every,
+            "stats_only_wall_s": [round(x, 3) for x in a_walls],
+            "health_wall_s": [round(x, 3) for x in b_walls],
+            "stats_only_step_ms": round(a / steps * 1e3, 3),
+            "health_step_ms": round(b / steps * 1e3, 3),
+            "top_k": health.DEFAULT_TOP_K,
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def run_trace_ab() -> None:
     """BENCH_TRACE=1: interleaved A-B overhead of proposal-lifecycle
     tracing (lifecycle.py) at the default 1-in-64 sampling.
@@ -1405,6 +1490,14 @@ def main() -> None:
             import traceback
 
             fail("telemetry-ab", traceback.format_exc())
+        return
+    if os.environ.get("BENCH_HEALTH") == "1":
+        try:
+            run_health_ab()
+        except Exception:
+            import traceback
+
+            fail("health-ab", traceback.format_exc())
         return
     if os.environ.get("BENCH_SERVE") == "1":
         try:

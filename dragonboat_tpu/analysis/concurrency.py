@@ -54,6 +54,7 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/telemetry.py",
     "dragonboat_tpu/flight.py",
     "dragonboat_tpu/lifecycle.py",
+    "dragonboat_tpu/core/health.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

@@ -67,6 +67,7 @@ KERNEL_FILE = "dragonboat_tpu/core/kernel.py"
 CONTRACT_FILES = (
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/kernel.py",
+    "dragonboat_tpu/core/health.py",
 )
 PARAMS_FILE = "dragonboat_tpu/core/params.py"
 
@@ -1295,6 +1296,27 @@ def runtime_check(kp=None, num_shards: int = _CHECK_SHARDS,
             lambda st, bx, ip: kernel.step(kp, st, bx, ip), state, box, inp)
         diff("StepOutput", out)
         diff("ShardState", new_state)
+
+    # health structures: C/TOPK/RW are host-side constants, and k clamps
+    # to G on small fleets (core/health.py) — the env mirrors that
+    from dragonboat_tpu.core import health as _health
+
+    hk = min(_health.DEFAULT_TOP_K, G)
+    axis_env.update({"C": _health.NUM_CLASSES, "TOPK": hk,
+                     "RW": _health.ROW_WIDTH})
+    digest = _health.empty_digest(G)
+    report, new_digest = jax.eval_shape(
+        lambda st, bx, dg: _health._fleet_health_impl(
+            st, bx, dg, k=_health.DEFAULT_TOP_K),
+        state, box.from_, digest)
+    diff("HealthReport", report)
+    diff("HealthDigest", new_digest)
+    import jax.numpy as jnp
+
+    row = jax.eval_shape(
+        _health._shard_row_impl, state, box.from_, digest,
+        jax.ShapeDtypeStruct((), jnp.int32))
+    diff("ShardRow", row)
     return findings
 
 

@@ -77,10 +77,12 @@ DEFAULT_CONTRACT_FILES = (
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/kernel.py",
     "dragonboat_tpu/core/fleet.py",
+    "dragonboat_tpu/core/health.py",
 )
 #: files interpreted at mesh level (G axis real) — see module docstring
 DEFAULT_ANALYSIS_FILES = (
     "dragonboat_tpu/core/fleet.py",
+    "dragonboat_tpu/core/health.py",
     "dragonboat_tpu/parallel/ici.py",
 )
 DEFAULT_CONST_FILES = ("dragonboat_tpu/core/params.py",)
@@ -91,6 +93,7 @@ DEFAULT_WALK_FILES = (
     "dragonboat_tpu/core/router.py",
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/fleet.py",
+    "dragonboat_tpu/core/health.py",
 )
 DEFAULT_ENGINE_FILES = (
     "dragonboat_tpu/engine/kernel_engine.py",
@@ -113,6 +116,7 @@ PART_BINDINGS = {
     "inbox": "Inbox",
     "inp": "StepInput",
     "out": "StepOutput",
+    "digest": "HealthDigest",
 }
 
 #: jax.lax named collectives — using one IS declaring cross-device flow
@@ -140,6 +144,7 @@ _DEVICE_SELF_ATTRS = frozenset({"state", "box", "_pending_dev", "_cut_dev"})
 _DEVICE_PRODUCERS = frozenset({
     "kernel_step", "kernel_step_donated", "step", "step_donated",
     "ici_serve_step", "ici_cluster_step", "fleet_stats",
+    "fleet_health", "shard_row",
     "output_row_flags", "to_device", "shard", "device_put", "_kernel_call",
 })
 
@@ -151,6 +156,7 @@ CACHE_SOURCES = (
     "dragonboat_tpu/core/router.py",
     "dragonboat_tpu/core/params.py",
     "dragonboat_tpu/core/fleet.py",
+    "dragonboat_tpu/core/health.py",
     "dragonboat_tpu/parallel/ici.py",
     "dragonboat_tpu/analysis/partition.py",
 )

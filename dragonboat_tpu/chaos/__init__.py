@@ -18,14 +18,21 @@ docs/test.md, re-expressed as replayable fault schedules).
 from dragonboat_tpu.chaos.crashfs import CrashPointFS
 from dragonboat_tpu.chaos.faultplan import FaultEvent, FaultPlan
 from dragonboat_tpu.chaos.oracle import OracleReport, check_convergence
-from dragonboat_tpu.chaos.runner import ScheduleResult, run_schedule
+from dragonboat_tpu.chaos.runner import (
+    DetectorResult,
+    ScheduleResult,
+    run_detector_differential,
+    run_schedule,
+)
 
 __all__ = [
     "CrashPointFS",
+    "DetectorResult",
     "FaultEvent",
     "FaultPlan",
     "OracleReport",
     "check_convergence",
     "ScheduleResult",
+    "run_detector_differential",
     "run_schedule",
 ]

@@ -102,6 +102,9 @@ class _Cluster:
     # then exercises crash/restart with a donated step in flight
     device_resident: bool = False
     pipeline_depth: int = 0
+    # extra ExpertConfig kwargs (detector differentials tune the health
+    # cadence/thresholds per fault kind)
+    expert_overrides: dict = field(default_factory=dict)
     hosts: dict = field(default_factory=dict)      # rid -> NodeHost
     mems: dict = field(default_factory=dict)       # rid -> MemFS
     fss: dict = field(default_factory=dict)        # rid -> CrashPointFS
@@ -124,15 +127,16 @@ class _Cluster:
             self._spawn(rid)
 
     def _nhconfig(self, rid: int) -> NodeHostConfig:
+        kw = dict(
+            fs=self.fss[rid],
+            kernel_log_cap=256, kernel_capacity=4,
+            kernel_pipeline_depth=self.pipeline_depth,
+            logdb=LogDBConfig(shards=1, recovery_mode="quarantine"))
+        kw.update(self.expert_overrides)
         return NodeHostConfig(
             raft_address=self.addrs[rid], rtt_millisecond=5,
             node_host_dir="/data",
-            expert=ExpertConfig(
-                fs=self.fss[rid],
-                kernel_log_cap=256, kernel_capacity=4,
-                kernel_pipeline_depth=self.pipeline_depth,
-                logdb=LogDBConfig(shards=1,
-                                  recovery_mode="quarantine")))
+            expert=ExpertConfig(**kw))
 
     def _spawn(self, rid: int) -> None:
         """Fresh NodeHost (+ fresh CrashPointFS) over rid's MemFS."""
@@ -188,16 +192,19 @@ class _Cluster:
         return total
 
     def leaderless_total(self) -> int:
-        """Sum of the ``fleet.leaderless_shards`` callback gauge over
+        """Sum of the ``health.leaderless_now`` callback gauge over
         live, unpartitioned hosts (evaluated through the legacy snapshot
-        view so this exercises the same path a scrape does)."""
+        view so this exercises the same path a scrape does).  The health
+        engine's merged snapshot counts host-resident shards alongside
+        device/mesh rows, so the oracle and the anomaly detector read
+        ONE source of truth."""
         total = 0
         for rid in self.live_rids():
             nh = self.hosts[rid]
             if nh._partitioned:
                 continue
             snap = nh.events.metrics.snapshot()
-            total += int(snap.get("fleet.leaderless_shards", 0))
+            total += int(snap.get("health.leaderless_now", 0))
         return total
 
     # -- event execution -------------------------------------------------
@@ -427,17 +434,29 @@ def run_schedule(seed: int, plan: FaultPlan | None = None,
             report.fail(f"acked-proposal counter {acked_seen} < "
                         f"{len(acked)} oracle-observed acks — telemetry "
                         "lost acked writes")
-        # 2. the leaderless gauge returns to 0 once converged (poll
-        #    briefly: a follower may learn the leader an append after
-        #    the journals equalize)
+        # 2. the leaderless gauge returns to 0 once converged.  A
+        #    follower may learn the leader an append after the journals
+        #    equalize, so this is a deadline-bounded wait — but EVENT-
+        #    driven, not a sleep-poll: every transition that can clear
+        #    leaderlessness lands a flight record (leader_change from
+        #    host-resident elections, anomaly_cleared from the device
+        #    health engines), so the oracle re-reads the gauge exactly
+        #    when the recorder wakes it
         if converged:
             deadline = time.time() + 5.0
+            seq = flight.RECORDER.next_seq
             leaderless = cluster.leaderless_total()
             while leaderless and time.time() < deadline:
-                time.sleep(0.05)
+                # wait for record #seq to land (anything after the gauge
+                # read), capped so a transition the recorder missed
+                # (e.g. a pre-sample race) still re-checks promptly
+                flight.RECORDER.wait_beyond(
+                    seq, timeout=min(0.5, max(0.0,
+                                              deadline - time.time())))
+                seq = flight.RECORDER.next_seq
                 leaderless = cluster.leaderless_total()
             if leaderless:
-                report.fail(f"fleet.leaderless_shards gauge stuck at "
+                report.fail(f"health.leaderless_now gauge stuck at "
                             f"{leaderless} after convergence")
         if not report.ok:
             # attach the flight-recorder tail so a failure report carries
@@ -449,3 +468,251 @@ def run_schedule(seed: int, plan: FaultPlan | None = None,
     return ScheduleResult(
         seed=seed, trace_json=canonical_json(executed), report=report,
         acked_count=len(acked), plan_json=plan.to_json())
+
+
+# -- detector differential --------------------------------------------------
+#
+# The fleet-health engine (core/health.py) is itself under chaos test:
+# each fault kind below must raise its MAPPED anomaly class during the
+# fault window (observed via the flight recorder's anomaly_raised edge,
+# so a one-tick flag cannot be missed by a polling race), every class
+# must clear to zero after the heal converges, and at sampled instants
+# the device report is cross-checked byte-for-byte against the
+# pure-python recount oracle.
+
+#: fault kind -> the anomaly class it must raise
+DETECTOR_FAULT_CLASS = {
+    # no quorum anywhere: every lane sits candidate/leaderless
+    "isolate_quorum": "leaderless",
+    # back-to-back leadership transfers: known-leader -> known-leader
+    # handoffs pump the churn leaky bucket
+    "leader_flap": "churn",
+    # a partitioned replica campaigns forever (pre_vote off), its term
+    # rising tick over tick
+    "campaign_storm": "term_runaway",
+}
+DETECTOR_FAULTS = tuple(sorted(DETECTOR_FAULT_CLASS))
+
+
+@dataclass
+class DetectorResult:
+    seed: int
+    fault: str
+    anomaly_class: str
+    raised: bool              # mapped class raised inside the window
+    cleared: bool             # ALL classes zero after convergence
+    differential_checks: int  # recount cross-checks performed
+    failures: list
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _health_differential(eng) -> tuple[bool, dict, dict]:
+    """Sample one engine's (state, inbox, digest) under its lock and
+    compare the jitted fleet_health report against the pure-python
+    recount — the device detector and the oracle must agree exactly."""
+    import jax
+
+    from dragonboat_tpu.core import health as _health
+
+    with eng.mu:
+        if eng._health_digest is None:
+            eng._health_digest = eng._make_health_digest()
+        state, inbox = eng.state, eng._fleet_inbox_from()
+        digest = eng._health_digest
+        report, _ = _health.fleet_health(
+            state, inbox, digest, thresholds=eng.health_thresholds,
+            k=eng.health_top_k)
+        state_h = jax.device_get(state)
+        inbox_h = jax.device_get(inbox)
+        digest_h = jax.device_get(digest)
+    dev = _health.report_to_dict(report)
+    ref, _ = _health.recount(state_h, inbox_h, digest_h,
+                             thresholds=eng.health_thresholds,
+                             k=eng.health_top_k)
+    return dev == ref, dev, ref
+
+
+def _wait_anomaly_raised(cls: str, since_seq: int, deadline: float) -> bool:
+    """Event-driven wait for an anomaly_raised flight record of ``cls``
+    recorded at sequence >= ``since_seq``."""
+    while True:
+        scanned_to = flight.RECORDER.next_seq
+        for rec in flight.RECORDER.tail():
+            if (rec["seq"] >= since_seq
+                    and rec["kind"] == flight.ANOMALY_RAISED
+                    and rec.get("cls") == cls):
+                return True
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            return False
+        # block until record #scanned_to lands (anything newer than the
+        # tail scan above), capped for safety against ring overwrite
+        flight.RECORDER.wait_beyond(scanned_to,
+                                    timeout=min(0.5, remaining))
+
+
+def run_detector_differential(seed: int, fault: str | None = None,
+                              n_replicas: int = 3,
+                              fault_window: float = 25.0,
+                              converge_timeout: float = 30.0
+                              ) -> DetectorResult:
+    """Run ONE fault schedule against a device-resident cluster and
+    check the health engine's verdicts (see module comment above).
+    ``fault`` defaults to ``DETECTOR_FAULTS[seed % 3]`` so consecutive
+    seeds sweep the taxonomy."""
+    from dragonboat_tpu.core import health as _health
+
+    if fault is None:
+        fault = DETECTOR_FAULTS[seed % len(DETECTOR_FAULTS)]
+    cls = DETECTOR_FAULT_CLASS[fault]
+    # fast health ticks; per-fault threshold tuning keeps the windows
+    # short without loosening what is being detected
+    overrides: dict = {"fleet_stats_every": 5}
+    if fault == "leader_flap":
+        # one observed known->known handoff trips the bucket
+        overrides["health_churn_trip"] = _health.CHURN_INC
+    elif fault == "campaign_storm":
+        # campaigns fire every ~election timeout; stretch the tick so
+        # each consecutive pair of ticks sees a higher term
+        overrides["fleet_stats_every"] = 20
+        overrides["health_runaway_ticks"] = 2
+    cluster = _Cluster(seed=seed, n=n_replicas, device_resident=True,
+                       expert_overrides=overrides)
+    failures: list = []
+    raised = False
+    cleared = False
+    diff_checks = 0
+
+    def check_diff(rid: int, where: str) -> None:
+        nonlocal diff_checks
+        eng = cluster.hosts[rid].kernel_engine
+        if eng is None:
+            failures.append(f"{where}: replica {rid} has no kernel engine")
+            return
+        ok, dev, ref = _health_differential(eng)
+        diff_checks += 1
+        if not ok:
+            failures.append(f"{where}: device report diverged from "
+                            f"recount: {dev} != {ref}")
+
+    def wait_leader(timeout: float) -> int:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for rid in cluster.live_rids():
+                nh = cluster.hosts[rid]
+                if nh._partitioned:
+                    continue
+                try:
+                    lid, ok = nh.get_leader_id(cluster.SHARD)
+                except Exception:
+                    continue
+                if ok and lid:
+                    return lid
+            time.sleep(0.05)
+        return 0
+
+    try:
+        cluster.start()
+        # generous settle: the FIRST device-resident cluster in a
+        # process pays the kernel jit compile inside this window
+        if not cluster.propose(b"genesis=1", timeout=45.0):
+            failures.append("no initial commit — cluster never settled")
+        lid = wait_leader(10.0)
+        if not lid:
+            failures.append("no leader before fault injection")
+        start_seq = flight.RECORDER.next_seq
+        deadline = time.time() + fault_window
+        rids = sorted(cluster.hosts)
+        healed: list = []
+
+        if fault == "isolate_quorum":
+            # partition the leader AND one follower: the remaining host
+            # campaigns without quorum, so every engine's lane persists
+            # leaderless past the threshold
+            victims = [lid] + [r for r in rids if r != lid][:1]
+            for r in victims:
+                cluster.hosts[r].partition_node()
+                healed.append(r)
+            raised = _wait_anomaly_raised(cls, start_seq, deadline)
+            observe = next(r for r in rids if r not in victims)
+            check_diff(observe, "mid-fault")
+        elif fault == "leader_flap":
+            # transfer leadership round-robin until the churn bucket
+            # trips (two transfers usually suffice; the loop is bounded
+            # by the fault window)
+            while not raised and time.time() < deadline:
+                cur = wait_leader(5.0)
+                if not cur:
+                    continue
+                target = next(r for r in rids if r != cur)
+                try:
+                    cluster.hosts[cur].request_leader_transfer(
+                        cluster.SHARD, target)
+                except Exception:
+                    pass
+                raised = _wait_anomaly_raised(
+                    cls, start_seq, min(deadline, time.time() + 2.0))
+            check_diff(rids[0], "mid-fault")
+        elif fault == "campaign_storm":
+            victim = next(r for r in rids if r != lid)
+            cluster.hosts[victim].partition_node()
+            healed.append(victim)
+            raised = _wait_anomaly_raised(cls, start_seq, deadline)
+            check_diff(victim, "mid-fault")
+        else:
+            raise ValueError(f"unknown detector fault {fault!r}")
+        if not raised:
+            failures.append(f"fault {fault} never raised anomaly class "
+                            f"{cls} within {fault_window}s")
+
+        # heal and converge (the convergence oracle of run_schedule,
+        # reduced to its journal-equality core)
+        for r in healed:
+            cluster.hosts[r].restore_partitioned_node()
+        cluster.reset_breakers()
+        marker = f"healed{seed}=1".encode()
+        if not cluster.propose(marker, timeout=15.0):
+            failures.append("post-heal proposal never acked")
+        deadline = time.time() + converge_timeout
+        converged = False
+        while time.time() < deadline and not converged:
+            js = cluster.journals()
+            if len(js) == cluster.n:
+                vals = list(js.values())
+                converged = (all(v == vals[0] for v in vals[1:])
+                             and marker in vals[0])
+            if not converged:
+                time.sleep(0.1)
+        if not converged:
+            failures.append("cluster did not converge after heal")
+
+        # every class must clear to zero — event-driven on the flight
+        # recorder (anomaly_cleared / leader_change wake the re-check)
+        def counts_all_zero() -> bool:
+            for rid in cluster.live_rids():
+                eng = cluster.hosts[rid].kernel_engine
+                d = getattr(eng, "last_health", None)
+                if d and any(d["class_count"].values()):
+                    return False
+            return True
+
+        deadline = time.time() + converge_timeout
+        cleared = counts_all_zero()
+        while not cleared and time.time() < deadline:
+            seq = flight.RECORDER.next_seq
+            flight.RECORDER.wait_beyond(
+                seq, timeout=min(0.5, max(0.0, deadline - time.time())))
+            cleared = counts_all_zero()
+        if not cleared:
+            failures.append("anomaly classes did not clear to zero "
+                            "after convergence")
+        check_diff(rids[0], "post-convergence")
+    finally:
+        cluster.close()
+    return DetectorResult(seed=seed, fault=fault, anomaly_class=cls,
+                          raised=raised, cleared=cleared,
+                          differential_checks=diff_checks,
+                          failures=failures)

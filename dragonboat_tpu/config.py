@@ -160,6 +160,19 @@ class ExpertConfig:
     # oracle); 1 overlaps host staging/output-retirement with the device
     # step, dispatching through the donating jit entry
     kernel_pipeline_depth: int = 0
+    # device-side health engine (core/health.py): rides the
+    # fleet_stats_every decimation, classifying every group into the
+    # anomaly taxonomy and fetching one O(K) triage report to host.
+    # health_top_k sizes the worst-offender list; 0 disables the pass
+    health_top_k: int = 8
+    # anomaly trip points, in health ticks (churn_trip is a leaky-bucket
+    # level: each observed leadership handoff adds CHURN_INC=4, the
+    # bucket drains 1/tick)
+    health_leaderless_ticks: int = 3
+    health_stall_ticks: int = 3
+    health_lag_ticks: int = 3
+    health_churn_trip: int = 8
+    health_runaway_ticks: int = 4
     # proposal-lifecycle tracing (lifecycle.py): every Nth proposal key
     # carries an end-to-end span stamped at each host hop (propose,
     # stage, dispatch, retire, save, fsync, apply, ack) and feeds the

@@ -312,7 +312,9 @@ class KernelEngine:
                  send_message, events: EventHub | None = None,
                  election_rtt: int = 10, heartbeat_rtt: int = 1,
                  fleet_stats_every: int = 10,
-                 pipeline_depth: int = 0) -> None:
+                 pipeline_depth: int = 0,
+                 health_top_k: int = 8,
+                 health_thresholds=None) -> None:
         self.kp = kp
         self.capacity = capacity
         self.send_message = send_message
@@ -416,6 +418,22 @@ class KernelEngine:
 
         _fleet.register_exposition(self.events.metrics.registry,
                                    lambda: self.last_fleet)
+        # decimated device-side anomaly classification (core/health.py):
+        # rides the fleet countdown; the per-group digest carry stays
+        # device resident and only the O(K) report crosses to host.
+        # health_top_k=0 disables the pass entirely
+        from dragonboat_tpu.core import health as _health
+
+        self.health_top_k = max(0, int(health_top_k))
+        self.health_thresholds = (
+            _health.HealthThresholds(*health_thresholds)
+            if health_thresholds is not None
+            else _health.DEFAULT_THRESHOLDS)
+        self._health_digest = None      # built lazily at the first tick
+        self.last_health: dict | None = None
+        self._health_seq = 0            # health ticks taken (flight stamp)
+        _health.register_exposition(self.events.metrics.registry,
+                                    lambda: self.last_health)
 
     # -- lane lifecycle ---------------------------------------------------
 
@@ -829,6 +847,8 @@ class KernelEngine:
                 if self._fleet_countdown <= 0:
                     self._fleet_countdown = self.fleet_stats_every
                     self._collect_fleet_stats()
+                    if self.health_top_k > 0:
+                        self._collect_health()
             return True
 
     def _is_registered(self, n: KernelNode) -> bool:
@@ -886,6 +906,57 @@ class KernelEngine:
 
         stats = _fleet.fleet_stats(self.state, self._fleet_inbox_from())
         self.last_fleet = _fleet.stats_to_dict(stats)
+
+    def _make_health_digest(self):
+        """Fresh all-zero digest matching the engine's lane geometry;
+        the mesh override shards it along G."""
+        from dragonboat_tpu.core import health as _health
+
+        return _health.empty_digest(self.capacity)
+
+    def _collect_health(self) -> None:
+        """Decimated anomaly classification (core/health.py), on the
+        same cadence (and under the same engine.mu post-step window) as
+        ``_collect_fleet_stats``.  The digest carry never leaves the
+        device; one O(K) HealthReport is fetched.  Class-count edges
+        (0 -> nonzero and back) are recorded as flight-recorder
+        anomaly_raised/anomaly_cleared events stamped with the engine's
+        health-tick sequence — never the wall clock."""
+        from dragonboat_tpu import flight
+        from dragonboat_tpu.core import health as _health
+
+        if self._health_digest is None:
+            self._health_digest = self._make_health_digest()
+        report, self._health_digest = _health.fleet_health(
+            self.state, self._fleet_inbox_from(), self._health_digest,
+            thresholds=self.health_thresholds, k=self.health_top_k)
+        prev = self.last_health
+        cur = _health.report_to_dict(report)
+        self._health_seq += 1
+        self.last_health = cur
+        prev_counts = prev["class_count"] if prev else {}
+        for cls, n in cur["class_count"].items():
+            was = prev_counts.get(cls, 0)
+            if n > 0 and was == 0:
+                flight.record(flight.ANOMALY_RAISED, cls=cls, count=n,
+                              tick=self._health_seq)
+            elif n == 0 and was > 0:
+                flight.record(flight.ANOMALY_CLEARED, cls=cls,
+                              tick=self._health_seq)
+
+    def health_row(self, lane: int) -> dict:
+        """One lane's drill-down row (NodeHost.shard_info): an O(1)
+        dynamic_index fetch of device scalars — the full ShardState is
+        never materialized on host."""
+        from dragonboat_tpu.core import health as _health
+
+        with self.mu:
+            if self._health_digest is None:
+                self._health_digest = self._make_health_digest()
+            row = _health.shard_row(
+                self.state, self._fleet_inbox_from(), self._health_digest,
+                np.int32(lane), thresholds=self.health_thresholds)
+        return _health.row_to_dict(row)
 
     def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
         if self.pipeline_depth > 0:

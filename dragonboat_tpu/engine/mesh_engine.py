@@ -66,7 +66,9 @@ class MeshEngine(KernelEngine):
 
     def __init__(self, kp: KP.KernelParams, spec: MeshSpec,
                  events=None, fleet_stats_every: int = 10,
-                 pipeline_depth: int = 0) -> None:
+                 pipeline_depth: int = 0,
+                 health_top_k: int = 8,
+                 health_thresholds=None) -> None:
         devs = jax.devices()
         need = spec.g_size * spec.replicas
         if len(devs) < need:
@@ -82,7 +84,9 @@ class MeshEngine(KernelEngine):
         total = self.cluster.total_rows
         super().__init__(kp, total, send_message=None, events=events,
                          fleet_stats_every=fleet_stats_every,
-                         pipeline_depth=pipeline_depth)
+                         pipeline_depth=pipeline_depth,
+                         health_top_k=health_top_k,
+                         health_thresholds=health_thresholds)
         # replica ids are fixed by the mesh addressing (route() targets
         # rid 1..R); rows keep them even while ABSENT
         rids = np.empty((total,), np.int32)
@@ -225,6 +229,13 @@ class MeshEngine(KernelEngine):
         # the mesh inbox is device-resident between steps; no host copy
         return self.box.from_
 
+    def _make_health_digest(self):
+        # the digest is per-row device state (part=G): shard it along
+        # the mesh like the ShardState/Inbox it is derived from
+        from dragonboat_tpu.core import health as _health
+
+        return self.cluster.shard(_health.empty_digest(self.capacity))
+
     def _kernel_call(self, inbox, inp):
         """Advance the mesh: host-staged inputs, device-routed messages.
         The host inbox builder is ignored — kernel-family traffic for
@@ -352,7 +363,9 @@ _REG_MU = threading.Lock()
 
 def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
                        events=None, fleet_stats_every: int = 10,
-                       pipeline_depth: int = 0) -> MeshEngine:
+                       pipeline_depth: int = 0,
+                       health_top_k: int = 8,
+                       health_thresholds=None) -> MeshEngine:
     with _REG_MU:
         eng = _REGISTRY.get(spec.name)
         if eng is None:
@@ -360,7 +373,9 @@ def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
             # is process-wide; geometry/kp mismatches raise below)
             eng = MeshEngine(kp, spec, events=events,
                              fleet_stats_every=fleet_stats_every,
-                             pipeline_depth=pipeline_depth)
+                             pipeline_depth=pipeline_depth,
+                             health_top_k=health_top_k,
+                             health_thresholds=health_thresholds)
             _REGISTRY[spec.name] = eng
         else:
             if eng.spec != spec:

@@ -28,6 +28,8 @@ QUARANTINE = "quarantine"
 CHAOS_FAULT = "chaos_fault"
 EVICTION = "eviction"
 SLOW_COMMIT = "slow_commit"
+ANOMALY_RAISED = "anomaly_raised"
+ANOMALY_CLEARED = "anomaly_cleared"
 
 
 class FlightRecorder:
@@ -38,6 +40,7 @@ class FlightRecorder:
             raise ValueError(f"capacity must be positive, got {capacity}")
         self.capacity = capacity
         self.mu = threading.Lock()
+        self._cond = threading.Condition(self.mu)
         self._records: deque = deque(maxlen=capacity)     # guarded-by: mu
         self._seq = 0                                     # guarded-by: mu
 
@@ -50,7 +53,18 @@ class FlightRecorder:
             rec = {"seq": seq, "kind": kind}
             rec.update(fields)
             self._records.append(rec)
+            self._cond.notify_all()
         return seq
+
+    def wait_beyond(self, seq: int, timeout: float | None = None) -> bool:
+        """Block until a record with sequence >= ``seq`` exists (i.e. at
+        least one record landed after the caller sampled ``next_seq``).
+        Event-driven convergence waits poll THIS instead of sleeping:
+        the chaos runner re-checks its oracle each time any transition
+        (anomaly_cleared, leader_change, ...) is recorded.  Returns
+        False on timeout."""
+        with self.mu:
+            return self._cond.wait_for(lambda: self._seq > seq, timeout)
 
     def __len__(self) -> int:
         with self.mu:

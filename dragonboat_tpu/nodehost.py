@@ -162,6 +162,13 @@ class NodeHost:
 
         _fleet.register_exposition(self.events.metrics.registry,
                                    self._fleet_snapshot, replace=True)
+        # merged anomaly-health view (core/health.py), same ownership
+        # protocol: the host's merged snapshot claims the family names
+        # before any engine's device-only registration can
+        from dragonboat_tpu.core import health as _health
+
+        _health.register_exposition(self.events.metrics.registry,
+                                    self._health_snapshot, replace=True)
         # a directly-injected ILogDB object cannot be reopened by
         # restart() (no recipe to rebuild it); factories can
         self._injected_logdb = logdb is not None
@@ -250,7 +257,10 @@ class NodeHost:
 
             self._metrics_server = MetricsServer(
                 [self.events.metrics.registry, GLOBAL],
-                address=nhconfig.metrics_address or "127.0.0.1:0")
+                address=nhconfig.metrics_address or "127.0.0.1:0",
+                health_source=self._health_snapshot,
+                info_source=self.info,
+                shard_info_source=self._shard_info_or_none)
             _LOG.info("NodeHost %s metrics endpoint on %s",
                       nhconfig.raft_address, self._metrics_server.address)
         self._auto_run = auto_run
@@ -294,6 +304,33 @@ class NodeHost:
             except Exception:
                 # a replica being torn down mid-scrape still counts
                 _fleet.add_host_shard(base, "follower", False, 0, 0)
+        return base
+
+    def _health_snapshot(self) -> dict:
+        """Scrape-time anomaly view: the engines' cached O(K) device
+        reports merged (offenders tagged by engine) with a host-side
+        recount of host-resident replicas.  The anomaly-class detectors
+        are device-side only, so host replicas contribute just the
+        instantaneous leaderless count — the single source of truth the
+        chaos convergence oracle reads."""
+        from dragonboat_tpu.core import health as _health
+
+        base = _health.empty_dict()
+        for name, eng in (("kernel", self.kernel_engine),
+                          ("mesh", self.mesh_engine)):
+            d = getattr(eng, "last_health", None)
+            if d:
+                _health.merge_into(base, d, engine=name)
+        with self.mu:
+            nodes = list(self.nodes.values())
+        for n in nodes:
+            if getattr(n, "engine", None) is not None:
+                continue        # device-resident: covered by the report
+            try:
+                if int(n.leader_id()) == 0:
+                    base["leaderless_now"] += 1
+            except Exception:
+                base["leaderless_now"] += 1   # torn down mid-scrape
         return base
 
     def _start_engine_threads(self) -> None:
@@ -602,11 +639,24 @@ class NodeHost:
                 self._kernel_params(), ex.kernel_capacity,
                 self._send_message, events=self.events,
                 fleet_stats_every=ex.fleet_stats_every,
-                pipeline_depth=ex.kernel_pipeline_depth)
+                pipeline_depth=ex.kernel_pipeline_depth,
+                health_top_k=ex.health_top_k,
+                health_thresholds=self._health_thresholds())
             self.kernel_engine.on_evict = self._on_kernel_evict
         init = self._build_lane_init(node, members)
         self._inject_into_engine(self.kernel_engine, node, init,
                                  "device-resident")
+
+    def _health_thresholds(self):
+        from dragonboat_tpu.core import health as _health
+
+        ex = self.config.expert
+        return _health.HealthThresholds(
+            leaderless_ticks=ex.health_leaderless_ticks,
+            stall_ticks=ex.health_stall_ticks,
+            lag_ticks=ex.health_lag_ticks,
+            churn_trip=ex.health_churn_trip,
+            runaway_ticks=ex.health_runaway_ticks)
 
     def _kernel_params(self, min_inbox: int = 0):
         import jax
@@ -703,7 +753,9 @@ class NodeHost:
                 self.mesh_engine = attach_mesh_engine(
                     kp, spec, events=self.events,
                     fleet_stats_every=self.config.expert.fleet_stats_every,
-                    pipeline_depth=self.config.expert.kernel_pipeline_depth)
+                    pipeline_depth=self.config.expert.kernel_pipeline_depth,
+                    health_top_k=self.config.expert.health_top_k,
+                    health_thresholds=self._health_thresholds())
             except Exception as e:
                 # not enough devices, or geometry mismatch with an
                 # already-attached engine
@@ -1415,6 +1467,112 @@ class NodeHost:
             raft_address=self.config.raft_address,
             shard_info_list=infos,
         )
+
+    @staticmethod
+    def _membership_dict(mb) -> dict:
+        return {
+            "addresses": {int(r): str(a) for r, a in mb.addresses.items()},
+            "non_votings": {int(r): str(a)
+                            for r, a in mb.non_votings.items()},
+            "witnesses": {int(r): str(a) for r, a in mb.witnesses.items()},
+            "config_change_id": int(mb.config_change_id),
+        }
+
+    def info(self) -> dict:
+        """JSON-able ``NodeHostInfo`` parity view plus the merged health
+        snapshot — the ``/debug/groups`` payload and ``fleet_doctor``'s
+        per-host input.  Same shard fields as ``get_node_host_info``,
+        with each shard's residency (host / device / mesh) attached."""
+        nhi = self.get_node_host_info()
+        with self.mu:
+            nodes = dict(self.nodes)
+        shards = []
+        for si in nhi.shard_info_list:
+            n = nodes.get(si.shard_id)
+            shards.append({
+                "shard_id": int(si.shard_id),
+                "replica_id": int(si.replica_id),
+                "leader_id": int(si.leader_id),
+                "term": int(si.term),
+                "is_leader": bool(si.is_leader),
+                "last_applied": int(si.last_applied),
+                "membership": self._membership_dict(si.membership),
+                "resident": self._residency(n),
+            })
+        return {
+            "node_host_id": nhi.node_host_id,
+            "raft_address": nhi.raft_address,
+            "health": self._health_snapshot(),
+            "shards": shards,
+        }
+
+    def _residency(self, node) -> str:
+        eng = getattr(node, "engine", None)
+        if eng is None:
+            return "host"
+        return "mesh" if eng is self.mesh_engine else "device"
+
+    def _shard_info_or_none(self, shard_id: int) -> dict | None:
+        """HTTP-callback form of ``shard_info``: None for a 404 instead
+        of a raised ShardNotFoundError."""
+        try:
+            return self.shard_info(shard_id)
+        except (ShardNotFoundError, RequestError):
+            return None
+
+    def shard_info(self, shard_id: int) -> dict:
+        """Drill-down for ONE group: the device row fetched O(1) by
+        dynamic_index (never a full-state materialization) merged with
+        every host-side register — pending books, logdb range + snapshot
+        meta, peer breaker states, and this host's gossip ShardView."""
+        node = self._node(shard_id)
+        mb = node.sm.get_membership()
+        reads = node.pending_reads
+        with reads.mu:
+            reads_pending = (len(reads.batching)
+                             + sum(len(v) for v in reads.pending.values())
+                             + len(reads.waiting))
+        info = {
+            "shard_id": int(shard_id),
+            "replica_id": int(node.replica_id),
+            "leader_id": int(node.leader_id()),
+            "term": int(node.node_term()),
+            "is_leader": bool(node.is_leader()),
+            "last_applied": int(node.sm.get_last_applied()),
+            "membership": self._membership_dict(mb),
+            "resident": self._residency(node),
+            "pending": {
+                "proposals": len(node.pending_proposals.pending),
+                "read_indexes": reads_pending,
+            },
+        }
+        rs = self.logdb.read_raft_state(shard_id, node.replica_id, 0)
+        ss = self.logdb.get_snapshot(shard_id, node.replica_id)
+        info["logdb"] = {
+            "first_index": int(rs.first_index) if rs is not None else 0,
+            "last_index": (int(rs.first_index + rs.entry_count - 1)
+                           if rs is not None else 0),
+            "entry_count": int(rs.entry_count) if rs is not None else 0,
+            "snapshot": ({"index": int(ss.index), "term": int(ss.term)}
+                         if ss is not None and ss.index else None),
+        }
+        me = self.config.raft_address
+        info["breakers"] = {
+            str(addr): self.hub.breaker(addr).state()
+            for addr in sorted(set(mb.addresses.values()))
+            if addr and addr != me
+        }
+        info["shard_view"] = {
+            "shard_id": int(shard_id),
+            "replicas": {int(r): str(a) for r, a in mb.addresses.items()},
+            "config_change_index": int(mb.config_change_id),
+            "leader_id": int(node.leader_id()),
+            "term": int(node.node_term()),
+        }
+        eng = getattr(node, "engine", None)
+        info["device"] = (eng.health_row(node.lane)
+                          if eng is not None else None)
+        return info
 
     def has_node_info(self, shard_id: int, replica_id: int) -> bool:
         return self.logdb.get_bootstrap_info(shard_id, replica_id) is not None

@@ -6,7 +6,15 @@ concatenated with the process-global one that module-scoped producers
 like the logdb engines write to), plus ``/flight`` — the flight
 recorder tail as JSON — ``/trace`` — the lifecycle tracer's completed
 proposal spans as Chrome-trace-event JSON, loadable directly in
-Perfetto / chrome://tracing — and ``/healthz``.
+Perfetto / chrome://tracing — ``/healthz``, and the fleet-health
+drill-down pair ``/debug/groups`` (NodeHost.info(): health summary +
+NodeHostInfo-parity shard list) and ``/debug/group/<id>``
+(NodeHost.shard_info(): one group's O(1) device row + host registers).
+
+``/healthz`` is honest: with a ``health_source`` wired (core/health.py
+merged snapshot), any nonzero anomaly-class count turns it into a 503
+with a structured JSON body naming the tripped classes; without one it
+keeps the legacy unconditional ``ok``.
 
 A ``ThreadingHTTPServer`` on a daemon thread: scrapes never run on an
 engine thread, and the collect path takes no registry lock while
@@ -33,11 +41,19 @@ class MetricsServer:
     """One /metrics listener over a list of registries."""
 
     def __init__(self, registries, address: str = "127.0.0.1:0",
-                 flight_recorder=None, tracer=None) -> None:
+                 flight_recorder=None, tracer=None,
+                 health_source=None, info_source=None,
+                 shard_info_source=None) -> None:
         self.registries = list(registries)
         self.flight_recorder = (flight_recorder if flight_recorder
                                 is not None else flight.RECORDER)
         self.tracer = tracer if tracer is not None else lifecycle.TRACER
+        # health_source() -> health dict (core/health.py empty_dict
+        # shape); info_source() -> NodeHost.info() dict;
+        # shard_info_source(shard_id) -> dict | None
+        self.health_source = health_source
+        self.info_source = info_source
+        self.shard_info_source = shard_info_source
         host, _, port = address.rpartition(":")
         if not host:
             host, port = address or "127.0.0.1", "0"
@@ -46,6 +62,7 @@ class MetricsServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:          # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path == "/metrics":
                     body = outer.render().encode("utf-8")
                     ctype = CONTENT_TYPE
@@ -59,12 +76,29 @@ class MetricsServer:
                             + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":
-                    body = b"ok\n"
-                    ctype = "text/plain"
+                    status, body, ctype = outer.healthz()
+                elif path == "/debug/groups" and outer.info_source:
+                    body = (json.dumps(outer.info_source(), sort_keys=True)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                elif (path.startswith("/debug/group/")
+                        and outer.shard_info_source):
+                    try:
+                        sid = int(path[len("/debug/group/"):])
+                    except ValueError:
+                        self.send_error(404)
+                        return
+                    d = outer.shard_info_source(sid)
+                    if d is None:
+                        self.send_error(404)
+                        return
+                    body = (json.dumps(d, sort_keys=True)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -85,6 +119,24 @@ class MetricsServer:
     def address(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"{host}:{port}"
+
+    def healthz(self) -> tuple[int, bytes, str]:
+        """(status, body, content-type) for /healthz: degraded (503 +
+        structured JSON) when any anomaly-class count is nonzero."""
+        if self.health_source is None:
+            return 200, b"ok\n", "text/plain"
+        h = self.health_source()
+        counts = h.get("class_count", {})
+        tripped = {c: n for c, n in counts.items() if n}
+        if not tripped:
+            return 200, b"ok\n", "text/plain"
+        body = json.dumps({
+            "status": "degraded",
+            "class_count": counts,
+            "anomalous": h.get("anomalous", 0),
+            "worst": h.get("worst", []),
+        }, sort_keys=True) + "\n"
+        return 503, body.encode("utf-8"), "application/json"
 
     def render(self) -> str:
         return "".join(r.exposition() for r in self.registries)

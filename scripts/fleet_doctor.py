@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Triage CLI over a NodeHost's fleet-health drill-down endpoints.
+
+Scrapes ``/debug/groups`` (NodeHost.info(): merged health snapshot +
+NodeHostInfo-parity shard list) from a running NodeHost's metrics
+listener, validates it strictly (core/health.py validate_info — the
+same schema check the tests pin), and prints a human triage report:
+anomaly class counts, the top-K worst-offender table, and the per-shard
+residency/leader summary.
+
+    python scripts/fleet_doctor.py 127.0.0.1:9090
+    python scripts/fleet_doctor.py 127.0.0.1:9090 --json
+    python scripts/fleet_doctor.py 127.0.0.1:9090 --shard 7
+    python scripts/fleet_doctor.py 127.0.0.1:9090 --shard 7 --json
+
+``--shard N`` drills into ``/debug/group/N`` (NodeHost.shard_info():
+the one group's O(1) device row merged with host registers — pending
+books, logdb range, breaker states, gossip ShardView).  ``--json``
+prints the validated payload verbatim, so the output round-trips
+against the endpoint byte-for-byte.
+
+Exit status: 0 healthy, 1 degraded (any anomaly class nonzero), 2
+unreachable or schema-invalid.  Stdlib-only on the wire (urllib).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragonboat_tpu.core import health  # noqa: E402
+
+
+def fetch_json(address: str, path: str, timeout: float):
+    url = f"http://{address}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_counts(counts: dict) -> str:
+    return " ".join(f"{c}={counts[c]}" for c in health.CLASS_NAMES)
+
+
+def render_groups(info: dict) -> str:
+    """Human triage report for a validated NodeHost.info() payload."""
+    h = info["health"]
+    degraded = any(h["class_count"].values())
+    lines = [
+        f"fleet doctor — {info['node_host_id']} @ {info['raft_address']}",
+        f"health: {'DEGRADED' if degraded else 'OK'}"
+        f"  anomalous={h['anomalous']} leaderless_now={h['leaderless_now']}",
+        f"  classes: {_fmt_counts(h['class_count'])}",
+    ]
+    if h["worst"]:
+        lines.append("  worst offenders:")
+        hdr = ("lane", "engine", "score", "classes", "term", "leader",
+               "lag", "stall", "churn")
+        rows = [hdr]
+        for w in h["worst"]:
+            rows.append((str(w["lane"]), w.get("engine", "-"),
+                         str(w["score"]), ",".join(w["classes"]) or "-",
+                         str(w["term"]), str(w["leader"]), str(w["lag"]),
+                         str(w["stall_ticks"]), str(w["churn_score"])))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(hdr))]
+        for r in rows:
+            lines.append("    " + "  ".join(
+                v.ljust(widths[i]) for i, v in enumerate(r)).rstrip())
+    lines.append(f"shards ({len(info['shards'])}):")
+    for s in sorted(info["shards"], key=lambda s: s["shard_id"]):
+        lead = ("leader" if s["is_leader"]
+                else f"leader={s['leader_id'] or '?'}")
+        lines.append(
+            f"  shard {s['shard_id']} replica {s['replica_id']}"
+            f"  [{s['resident']}]  {lead} term={s['term']}"
+            f" applied={s['last_applied']}")
+    return "\n".join(lines)
+
+
+def render_shard(si: dict) -> str:
+    """Human drill-down for a validated NodeHost.shard_info() payload."""
+    lines = [
+        f"shard {si['shard_id']} replica {si['replica_id']}"
+        f"  [{si['resident']}]",
+        f"  leader={si['leader_id']} term={si['term']}"
+        f" is_leader={si['is_leader']} applied={si['last_applied']}",
+        f"  pending: proposals={si['pending']['proposals']}"
+        f" read_indexes={si['pending']['read_indexes']}",
+    ]
+    ldb = si["logdb"]
+    snap = ldb["snapshot"]
+    snap_s = (f" snapshot@{snap['index']}(t{snap['term']})"
+              if snap else " no-snapshot")
+    lines.append(f"  logdb: [{ldb['first_index']}, {ldb['last_index']}]"
+                 f" count={ldb['entry_count']}{snap_s}")
+    if si["breakers"]:
+        lines.append("  breakers: " + " ".join(
+            f"{a}={s}" for a, s in sorted(si["breakers"].items())))
+    dev = si["device"]
+    if dev is None:
+        lines.append("  device: (host-resident — no device row)")
+    else:
+        lines.append(
+            f"  device: role={dev['role']} commit={dev['committed']}"
+            f" applied={dev['applied']} last={dev['last']}"
+            f" inbox={dev['inbox_occ']}"
+            f" classes={','.join(dev['classes']) or '-'}")
+        lines.append(
+            f"    counters: leaderless={dev['leaderless_ticks']}"
+            f" stall={dev['stall_ticks']} lag={dev['lag_ticks']}"
+            f" churn={dev['churn_score']} runaway={dev['runaway_ticks']}")
+    mb = si["membership"]
+    lines.append("  members: " + " ".join(
+        f"{r}@{a}" for r, a in sorted(mb["addresses"].items(),
+                                      key=lambda kv: int(kv[0]))))
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("address", help="host:port of the metrics endpoint")
+    ap.add_argument("--shard", type=int, default=None,
+                    help="drill into /debug/group/<id> for one group")
+    ap.add_argument("--json", action="store_true",
+                    help="print the validated payload as JSON instead of "
+                         "the human report")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args()
+
+    path = (f"/debug/group/{args.shard}" if args.shard is not None
+            else "/debug/groups")
+    try:
+        obj = fetch_json(args.address, path, args.timeout)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"error: cannot scrape http://{args.address}{path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        if args.shard is not None:
+            health.validate_shard_info(obj)
+        else:
+            health.validate_info(obj)
+    except ValueError as e:
+        print(f"error: schema validation failed: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(obj, indent=2, sort_keys=True))
+    else:
+        print(render_shard(obj) if args.shard is not None
+              else render_groups(obj))
+
+    if args.shard is not None:
+        degraded = bool(obj["device"] and obj["device"]["classes"])
+    else:
+        degraded = any(obj["health"]["class_count"].values())
+    return 1 if degraded else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,13 @@ raw text or a flat JSON object.
     python scripts/metrics_dump.py 127.0.0.1:9090
     python scripts/metrics_dump.py 127.0.0.1:9090 --json
     python scripts/metrics_dump.py 127.0.0.1:9090 --flight
+    python scripts/metrics_dump.py 127.0.0.1:9090 --doctor
     python scripts/metrics_dump.py 127.0.0.1:9090 --trace > trace.json
+
+``--doctor`` scrapes /debug/groups — the fleet-health drill-down
+(NodeHost.info(): merged anomaly snapshot + NodeHostInfo-parity shard
+list) — and strictly validates it against the core/health.py schema
+before printing (see scripts/fleet_doctor.py for the human report).
 
 ``--trace`` scrapes /trace — the proposal-lifecycle spans as
 Chrome-trace-event JSON — and validates it strictly
@@ -27,9 +33,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.error
 import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def fetch(address: str, path: str, timeout: float) -> str:
@@ -51,6 +60,9 @@ def main() -> int:
                     help="dump /trace (proposal-lifecycle spans as "
                          "Chrome-trace-event JSON, Perfetto-loadable) "
                          "instead of /metrics")
+    ap.add_argument("--doctor", action="store_true",
+                    help="dump /debug/groups (fleet-health drill-down) "
+                         "instead of /metrics, strictly schema-validated")
     ap.add_argument("--no-validate", action="store_true",
                     help="skip strict validation (exposition parsing / "
                          "Chrome-trace checks)")
@@ -58,7 +70,8 @@ def main() -> int:
     args = ap.parse_args()
 
     path = ("/trace" if args.trace
-            else "/flight" if args.flight else "/metrics")
+            else "/flight" if args.flight
+            else "/debug/groups" if args.doctor else "/metrics")
     try:
         text = fetch(args.address, path, args.timeout)
     except (urllib.error.URLError, OSError) as e:
@@ -83,6 +96,26 @@ def main() -> int:
                 return 1
             print(f"ok: {n} trace event(s)", file=sys.stderr)
         print(text, end="" if text.endswith("\n") else "\n")
+        return 0
+
+    if args.doctor:
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            print(f"error: /debug/groups is not valid JSON: {e}",
+                  file=sys.stderr)
+            return 1
+        if not args.no_validate:
+            from dragonboat_tpu.core.health import validate_info
+
+            try:
+                n = validate_info(obj)
+            except ValueError as e:
+                print(f"error: /debug/groups schema validation failed: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"ok: {n} shard(s)", file=sys.stderr)
+        print(json.dumps(obj, indent=2, sort_keys=True))
         return 0
 
     if args.flight:

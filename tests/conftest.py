@@ -57,7 +57,7 @@ _RETRY_MODULES = (
     "test_durable_nodehost", "test_monkey", "test_vfs",
     "test_snapshot_stream", "test_kernel_engine", "test_tools",
     "test_history", "test_tan", "test_encoded", "test_examples",
-    "test_chaos_faults", "test_chaos_schedules",
+    "test_chaos_faults", "test_chaos_schedules", "test_health",
 )
 
 # module -> number of tests that needed the second attempt, THIS process.

@@ -1,0 +1,456 @@
+"""Fleet health engine (core/health.py): randomized differential vs the
+pure-python recount, top-K tie determinism, O(K) transfer shapes, digest
+carry through the live engines at both pipeline depths, the honest
+/healthz + /debug drill-down endpoints, the doctor CLIs, and the chaos
+detector differential."""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from dragonboat_tpu.core import health
+from dragonboat_tpu.core import params as KP
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _perturb(state, rng):
+    """Random host-side mutation of the health-relevant columns — the
+    differential must hold for ANY state, not just reachable ones."""
+    G = state.committed.shape[0]
+    fields = {}
+    for name in ("committed", "applied", "term", "leader", "last"):
+        col = np.array(jax.device_get(getattr(state, name)))
+        mask = rng.random(G) < 0.4
+        col[mask] = rng.integers(0, 12, mask.sum())
+        fields[name] = jax.numpy.asarray(col.astype(np.int32))
+    # vacate a group or two so occupancy gating is exercised
+    kind = np.array(jax.device_get(state.kind))
+    for g in rng.integers(0, G, 2):
+        if rng.random() < 0.5:
+            kind[g, :] = KP.K_ABSENT
+    fields["kind"] = jax.numpy.asarray(kind.astype(np.int32))
+    return state._replace(**fields)
+
+
+@pytest.mark.parametrize("groups,replicas,seed", [(1, 3, 11), (4, 3, 22),
+                                                  (8, 5, 33)])
+def test_fleet_health_matches_recount_randomized(groups, replicas, seed):
+    """Drive real elections, then randomized perturbations, carrying the
+    digest across ticks on BOTH sides — report and digest must agree
+    byte-for-byte every tick."""
+    from tests.kernel_harness import KernelCluster
+
+    c = KernelCluster(groups, replicas)
+    for _ in range(30):
+        c.step(tick=True)
+    rng = np.random.default_rng(seed)
+    state = c.state
+    inbox = c._build_inbox().from_
+    digest = health.empty_digest(c.G)
+    for tick in range(6):
+        state = _perturb(state, rng)
+        report, new_digest = health.fleet_health(state, inbox, digest, k=4)
+        got = health.report_to_dict(report)
+        want, want_digest = health.recount(
+            jax.device_get(state), jax.device_get(inbox),
+            jax.device_get(digest), k=4)
+        assert got == want, f"tick {tick}: {got} != {want}"
+        got_digest = {f: [int(v) for v in jax.device_get(getattr(
+            new_digest, f))] for f in health.HealthDigest._fields}
+        assert got_digest == want_digest, f"tick {tick} digest"
+        digest = new_digest
+
+
+def test_fleet_health_sharded_two_device_mesh():
+    """The jitted pass under a 2-device G-sharded placement (the
+    ``part=G`` contract) returns the same report as the recount."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+    from tests.kernel_harness import KernelCluster
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs 2 devices")
+    c = KernelCluster(4, 3)          # G = 12, divisible by 2
+    for _ in range(30):
+        c.step(tick=True)
+    mesh = Mesh(np.array(devs[:2]), ("g",))
+
+    def put(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == c.G:
+            spec = PS("g", *([None] * (leaf.ndim - 1)))
+        else:
+            spec = PS()
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    state = jax.tree.map(put, c.state)
+    inbox = put(c._build_inbox().from_)
+    digest = jax.tree.map(put, health.empty_digest(c.G))
+    for _ in range(2):
+        report, digest = health.fleet_health(state, inbox, digest, k=4)
+    got = health.report_to_dict(report)
+    ref_digest = health.empty_digest(c.G)
+    for _ in range(2):
+        want, ref_digest_d = health.recount(
+            jax.device_get(state), jax.device_get(inbox),
+            jax.device_get(ref_digest), k=4)
+        ref_digest = health.HealthDigest(**{
+            f: jax.numpy.asarray(np.array(v, np.int32))
+            for f, v in ref_digest_d.items()})
+    assert got == want
+
+
+def _synthetic_state(G, leaderless_rows):
+    """Minimal stand-in with the columns fleet_health reads: every row
+    occupied, ``leaderless_rows`` have no leader."""
+    from collections import namedtuple
+
+    S = namedtuple("S", "kind role term vote leader committed applied "
+                   "last stable processed snap_index snap_term")
+    i32 = np.int32
+    leader = np.full(G, 2, i32)
+    leader[list(leaderless_rows)] = KP.NO_LEADER
+    z = np.zeros(G, i32)
+    return S(kind=np.full((G, 3), KP.K_VOTER, i32), role=z,
+             term=np.ones(G, i32), vote=z, leader=leader,
+             committed=z, applied=z, last=z, stable=z, processed=z,
+             snap_index=z, snap_term=z)
+
+
+def test_top_k_tie_determinism():
+    """Equal severity scores order by ascending lane index, stably."""
+    G, k = 16, 8
+    tied = [3, 7, 11, 14]
+    state = _synthetic_state(G, tied)
+    inbox = np.zeros((G, 4), np.int32)
+    digest = health.empty_digest(G)
+    # tick past the leaderless threshold so all four trip with EQUAL
+    # scores (identical counters)
+    for _ in range(health.DEFAULT_THRESHOLDS.leaderless_ticks + 1):
+        report, digest = health.fleet_health(state, inbox, digest, k=k)
+    idx = [int(v) for v in jax.device_get(report.worst_idx)]
+    score = [int(v) for v in jax.device_get(report.worst_score)]
+    assert idx[:4] == tied                 # ascending lane among ties
+    assert score[0] == score[3] > 0
+    # stable across repeated calls on identical inputs, and the recount
+    # agrees on the tie order (digest here is the PRE-tick carry that
+    # produced `report`, i.e. the value before the last loop iteration)
+    prev = health.empty_digest(G)
+    for _ in range(health.DEFAULT_THRESHOLDS.leaderless_ticks):
+        _, prev = health.fleet_health(state, inbox, prev, k=k)
+    rerun, _ = health.fleet_health(state, inbox, prev, k=k)
+    assert health.report_to_dict(rerun) == health.report_to_dict(report)
+    want, _ = health.recount(state, inbox, jax.device_get(prev), k=k)
+    assert health.report_to_dict(report) == want
+
+
+def test_report_shapes_are_o_k_not_o_g():
+    """The host transfer is O(K) regardless of G (asserted via fetched
+    array shapes), and the drill-down row is O(1) scalars."""
+    k = 8
+    shapes = {}
+    for G in (16, 256):
+        state = _synthetic_state(G, [1])
+        inbox = np.zeros((G, 4), np.int32)
+        report, digest = health.fleet_health(state, inbox,
+                                             health.empty_digest(G), k=k)
+        shapes[G] = [tuple(leaf.shape) for leaf in report]
+        row = health.shard_row(state, inbox, digest, np.int32(1))
+        assert all(leaf.shape == () for leaf in row)
+    assert shapes[16] == shapes[256] == [
+        (health.NUM_CLASSES,), (), (), (k,), (k,), (k, health.ROW_WIDTH)]
+
+
+def test_top_k_clamps_to_small_fleets():
+    """k larger than G must clamp, not fail the trace (regression: the
+    default k=8 on a capacity-4 engine)."""
+    G = 4
+    state = _synthetic_state(G, [0])
+    report, _ = health.fleet_health(state, np.zeros((G, 4), np.int32),
+                                    health.empty_digest(G), k=8)
+    assert report.worst_idx.shape == (G,)
+
+
+# ---------------------------------------------------------------------
+# live engines: digest carry at both pipeline depths + shard_info parity
+
+
+def _cluster(prefix, depth):
+    from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+
+    from test_nodehost import KVStateMachine
+
+    addrs = {1: f"{prefix}-1", 2: f"{prefix}-2", 3: f"{prefix}-3"}
+    hosts = {rid: NodeHost(NodeHostConfig(
+        raft_address=a, rtt_millisecond=5, enable_metrics=True,
+        expert=ExpertConfig(kernel_log_cap=256, kernel_capacity=4,
+                            fleet_stats_every=5,
+                            kernel_pipeline_depth=depth)))
+        for rid, a in addrs.items()}
+    for rid in addrs:
+        hosts[rid].start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            device_resident=True))
+    return hosts
+
+
+def _wait(cond, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return cond()
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_digest_carries_across_decimated_ticks(depth):
+    """The per-group digest advances one tick per health collection on
+    the live engine — at pipeline depth 0 and through the overlapped
+    donating step loop at depth 1."""
+    hosts = _cluster(f"hc{depth}", depth)
+    try:
+        assert _wait(lambda: any(
+            h.get_leader_id(1)[1] and h.get_leader_id(1)[0]
+            for h in hosts.values()), 45)
+        eng = hosts[1].kernel_engine
+        assert _wait(lambda: eng._health_seq >= 3, 30), "no health ticks"
+        with eng.mu:
+            seq = eng._health_seq
+            ticks = jax.device_get(eng._health_digest.ticks)
+            lane = hosts[1].nodes[1].lane
+        # the digest is the carry of exactly the ticks taken; occupied
+        # and vacant lanes advance together (ticks is uniform)
+        assert int(ticks[lane]) == seq
+        assert all(int(t) == seq for t in ticks)
+        # healthy steady state: no anomaly classes tripped
+        assert _wait(lambda: eng.last_health is not None
+                     and not any(eng.last_health["class_count"].values()),
+                     10)
+    finally:
+        for h in hosts.values():
+            h.close()
+
+
+def test_shard_info_matches_device_row_recount():
+    """NodeHost.shard_info's device row equals a recount of that row
+    from the (test-only) full-state fetch, and round-trips through
+    /debug/group/<id> and fleet_doctor --json."""
+    hosts = _cluster("hs", 0)
+    try:
+        lid = None
+
+        def leader():
+            nonlocal lid
+            for rid, h in hosts.items():
+                l, ok = h.get_leader_id(1)
+                if ok and l:
+                    lid = rid
+                    return True
+            return False
+
+        assert _wait(leader, 45)
+        nh = hosts[lid]
+        eng = nh.kernel_engine
+        assert _wait(lambda: eng._health_seq >= 1, 30)
+        node = nh.nodes[1]
+        with eng.mu:
+            # snapshot the inbox ONCE (transport threads mutate the host
+            # buffer in place) and feed the same copy to both sides; the
+            # jnp state pytree is immutable, so sampling it twice under
+            # mu is consistent
+            inbox_h = np.array(jax.device_get(eng._fleet_inbox_from()))
+            row = health.shard_row(eng.state, inbox_h,
+                                   eng._health_digest, np.int32(node.lane),
+                                   thresholds=eng.health_thresholds)
+            state_h = jax.device_get(eng.state)
+        got = health.row_to_dict(row)
+        g = node.lane
+        for f in ("role", "term", "vote", "leader", "committed", "applied",
+                  "last", "stable", "processed", "snap_index", "snap_term"):
+            assert got[f] == int(getattr(state_h, f)[g]), f
+        assert got["inbox_occ"] == int((np.asarray(inbox_h)[g] != 0).sum())
+
+        si = nh.shard_info(1)
+        health.validate_shard_info(si)
+        assert si["resident"] == "device" and si["device"] is not None
+        # HTTP round-trip (json normalizes int membership keys)
+        addr = nh.metrics_address
+        got_ep = json.loads(urllib.request.urlopen(
+            f"http://{addr}/debug/group/1", timeout=5).read())
+        health.validate_shard_info(got_ep)
+        assert set(got_ep) == set(si)
+        assert got_ep["membership"] == json.loads(
+            json.dumps(si["membership"]))
+        # /debug/groups serves info() with the same schema
+        groups = json.loads(urllib.request.urlopen(
+            f"http://{addr}/debug/groups", timeout=5).read())
+        assert health.validate_info(groups) == 1
+        # unknown group -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{addr}/debug/group/99",
+                                   timeout=5)
+        assert ei.value.code == 404
+    finally:
+        for h in hosts.values():
+            h.close()
+
+
+# ---------------------------------------------------------------------
+# /healthz honesty + doctor CLIs (synthetic sources, no cluster)
+
+
+def _mk_server(snapshot):
+    from dragonboat_tpu.server.metrics_http import MetricsServer
+
+    state = {"h": snapshot}
+    info = {"node_host_id": "nhid-test", "raft_address": "t-1",
+            "health": snapshot,
+            "shards": [{"shard_id": 1, "replica_id": 2, "leader_id": 3,
+                        "term": 4, "is_leader": False, "last_applied": 5,
+                        "membership": {"addresses": {1: "t-1"},
+                                       "non_votings": {}, "witnesses": {},
+                                       "config_change_id": 1},
+                        "resident": "host"}]}
+    srv = MetricsServer([], address="127.0.0.1:0",
+                        health_source=lambda: state["h"],
+                        info_source=lambda: dict(info, health=state["h"]),
+                        shard_info_source=lambda sid: None)
+    return srv, state
+
+
+def test_healthz_honest_on_anomalies():
+    srv, state = _mk_server(health.empty_dict())
+    try:
+        ok = urllib.request.urlopen(f"http://{srv.address}/healthz",
+                                    timeout=5)
+        assert ok.status == 200 and ok.read() == b"ok\n"
+        bad = health.empty_dict()
+        bad["class_count"]["commit_stall"] = 2
+        bad["anomalous"] = 2
+        state["h"] = bad
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{srv.address}/healthz",
+                                   timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["status"] == "degraded"
+        assert body["class_count"]["commit_stall"] == 2
+        # back to healthy -> 200 again
+        state["h"] = health.empty_dict()
+        ok = urllib.request.urlopen(f"http://{srv.address}/healthz",
+                                    timeout=5)
+        assert ok.status == 200
+    finally:
+        srv.close()
+
+
+def test_fleet_doctor_cli_and_metrics_dump_doctor(capsys):
+    fd = _load_script("fleet_doctor")
+    md = _load_script("metrics_dump")
+    srv, state = _mk_server(health.empty_dict())
+    try:
+        import sys
+
+        argv = sys.argv
+        try:
+            sys.argv = ["fleet_doctor.py", srv.address]
+            assert fd.main() == 0
+            out = capsys.readouterr().out
+            assert "health: OK" in out and "shard 1" in out
+            # degraded fleet: nonzero exit + offender table
+            bad = health.empty_dict()
+            bad["class_count"]["leaderless"] = 1
+            bad["anomalous"] = 1
+            bad["worst"] = [dict({f: 0 for f in health.ROW_FIELDS},
+                                 lane=3, score=24, flags=1,
+                                 classes=["leaderless"], engine="kernel")]
+            state["h"] = bad
+            sys.argv = ["fleet_doctor.py", srv.address]
+            assert fd.main() == 1
+            out = capsys.readouterr().out
+            assert "DEGRADED" in out and "worst offenders" in out
+            # --json round-trips the endpoint payload verbatim
+            sys.argv = ["fleet_doctor.py", srv.address, "--json"]
+            assert fd.main() == 1
+            cli = json.loads(capsys.readouterr().out)
+            ep = json.loads(urllib.request.urlopen(
+                f"http://{srv.address}/debug/groups", timeout=5).read())
+            assert cli == ep
+            # metrics_dump --doctor validates strictly and prints JSON
+            sys.argv = ["metrics_dump.py", srv.address, "--doctor"]
+            assert md.main() == 0
+            captured = capsys.readouterr()
+            assert json.loads(captured.out) == ep
+            assert "ok: 1 shard(s)" in captured.err
+        finally:
+            sys.argv = argv
+    finally:
+        srv.close()
+
+
+def test_schema_validation_is_strict():
+    good = health.empty_dict()
+    health.validate_health(good)
+    bad = health.empty_dict()
+    bad["class_count"]["bogus"] = 1
+    with pytest.raises(ValueError, match="class_count"):
+        health.validate_health(bad)
+    bad2 = health.empty_dict()
+    bad2["anomalous"] = "3"
+    with pytest.raises(ValueError, match="anomalous"):
+        health.validate_health(bad2)
+    with pytest.raises(ValueError, match="missing key"):
+        health.validate_info({"node_host_id": "x", "raft_address": "y",
+                              "health": health.empty_dict()})
+    with pytest.raises(ValueError, match="resident"):
+        health.validate_info({
+            "node_host_id": "x", "raft_address": "y",
+            "health": health.empty_dict(),
+            "shards": [{"shard_id": 1, "replica_id": 1, "leader_id": 0,
+                        "term": 0, "last_applied": 0, "is_leader": False,
+                        "membership": {"addresses": {}, "non_votings": {},
+                                       "witnesses": {},
+                                       "config_change_id": 0},
+                        "resident": "gpu"}]})
+
+
+# ---------------------------------------------------------------------
+# chaos detector differential
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_detector_differential(seed):
+    """Each fault kind raises its mapped anomaly class inside the fault
+    window, everything clears after convergence, and the device report
+    agrees with the pure-python recount at every sampled instant."""
+    from dragonboat_tpu.chaos.runner import (
+        DETECTOR_FAULT_CLASS,
+        DETECTOR_FAULTS,
+        run_detector_differential,
+    )
+
+    r = run_detector_differential(seed)
+    assert r.fault == DETECTOR_FAULTS[seed % len(DETECTOR_FAULTS)]
+    assert r.anomaly_class == DETECTOR_FAULT_CLASS[r.fault]
+    assert r.ok, r.failures
+    assert r.raised and r.cleared
+    assert r.differential_checks >= 2
