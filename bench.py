@@ -54,7 +54,10 @@ run_health_ab), BENCH_PIPELINE=1
 pipelined step loops with commit-latency percentiles per arm — see
 run_pipeline_ab), BENCH_TRACE=1 (standalone mode: interleaved A-B
 overhead of proposal-lifecycle tracing at default 1/64 sampling on the
-full serving path — see run_trace_ab).
+full serving path — see run_trace_ab), BENCH_CAPACITY=1 (standalone
+mode: interleaved A-B overhead of the capacity rail — compile-tracker
+wrappers + tree-bytes walk + snapshot assembly — on top of the
+stats+health path — see run_capacity_ab).
 """
 
 import json
@@ -1125,6 +1128,120 @@ def run_health_ab() -> None:
     })
 
 
+def run_capacity_ab() -> None:
+    """BENCH_CAPACITY=1: interleaved A-B overhead of the capacity rail
+    (capacity.py) on top of the fleet_stats + fleet_health production
+    path, at the engine's decimation cadence.
+
+    Arm A is the post-health production path: the bench loop in
+    ``every``-step launches plus one fleet_stats and one fleet_health
+    call + fetch per launch.  Arm B routes the same three dispatches
+    through CompileTracker wrappers (the cache-size probe around every
+    call) and adds exactly what KernelEngine._collect_capacity adds per
+    launch — one measure_tree_bytes walk over the live trees plus one
+    engine_snapshot assembly (contracts model + allocator stats +
+    watermark check).  Arms interleave A,B,A,B,... (median-of-3 per
+    arm) so box drift lands on both.  Knobs: BENCH_CAPACITY_GROUPS
+    (default 10000), BENCH_CAPACITY_STEPS (120), BENCH_CAPACITY_EVERY
+    (10)."""
+    import jax
+
+    from dragonboat_tpu import capacity
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core import fleet, health
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_CAPACITY_GROUPS", "10000"))
+    steps = int(os.environ.get("BENCH_CAPACITY_STEPS", "120"))
+    every = max(1, int(os.environ.get("BENCH_CAPACITY_EVERY", "10")))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    state, box = elect_all(kp, replicas, state)
+    num_lanes = int(state.term.shape[0])
+    digest = health.empty_digest(num_lanes)
+    classes = ("ShardState", "HealthDigest")   # KernelEngine's model set
+
+    wrapped = {
+        "bench_run_steps":
+            capacity.TRACKER.wrap("bench_run_steps", run_steps),
+        "bench_fleet_stats":
+            capacity.TRACKER.wrap("bench_fleet_stats", fleet.fleet_stats),
+        "bench_fleet_health":
+            capacity.TRACKER.wrap("bench_fleet_health",
+                                  health.fleet_health),
+    }
+    peak = 0
+    seq = 0
+
+    def window(with_capacity: bool) -> float:
+        nonlocal state, box, digest, peak, seq
+        t0 = time.time()
+        done = 0
+        while done < steps:
+            done += every
+            if not with_capacity:
+                state, box = run_steps(kp, replicas, every, True, True,
+                                       state, box)
+                fleet.stats_to_dict(fleet.fleet_stats(state, box.from_))
+                report, digest = health.fleet_health(state, box.from_,
+                                                     digest)
+                health.report_to_dict(report)
+                continue
+            state, box = wrapped["bench_run_steps"](
+                kp, replicas, every, True, True, state, box)
+            fleet.stats_to_dict(
+                wrapped["bench_fleet_stats"](state, box.from_))
+            report, digest = wrapped["bench_fleet_health"](
+                state, box.from_, digest)
+            health.report_to_dict(report)
+            seq += 1
+            live = capacity.measure_tree_bytes(state, digest)
+            peak = max(peak, live)
+            capacity.engine_snapshot(
+                kp, num_lanes, live, peak,
+                {n: w.stats() for n, w in wrapped.items()},
+                ticks=seq, classes=classes)
+        state.term.block_until_ready()
+        return time.time() - t0
+
+    # warm every executable (run_steps at `every`, fleet_stats,
+    # fleet_health, and the capacity host path) outside the timed windows
+    window(True)
+    a_walls, b_walls = [], []
+    for _ in range(3):
+        a_walls.append(window(False))
+        b_walls.append(window(True))
+    a = sorted(a_walls)[1]
+    b = sorted(b_walls)[1]
+    overhead_pct = (b - a) / a * 100.0
+    emit({
+        "metric": (f"capacity-rail step-latency overhead, {g} groups x "
+                   f"{replicas} replicas, decimation N={every}"),
+        "value": round(overhead_pct, 2),
+        "unit": "% vs stats+health step",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "replicas": replicas,
+            "steps_per_arm_window": steps,
+            "decimation_every": every,
+            "plain_wall_s": [round(x, 3) for x in a_walls],
+            "capacity_wall_s": [round(x, 3) for x in b_walls],
+            "plain_step_ms": round(a / steps * 1e3, 3),
+            "capacity_step_ms": round(b / steps * 1e3, 3),
+            "bench_entries": {n: w.stats() for n, w in wrapped.items()},
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def run_trace_ab() -> None:
     """BENCH_TRACE=1: interleaved A-B overhead of proposal-lifecycle
     tracing (lifecycle.py) at the default 1-in-64 sampling.
@@ -1467,6 +1584,14 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_CAPACITY") == "1":
+        try:
+            run_capacity_ab()
+        except Exception:
+            import traceback
+
+            fail("capacity-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_TRACE") == "1":
         try:
             run_trace_ab()

@@ -55,6 +55,7 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/flight.py",
     "dragonboat_tpu/lifecycle.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/capacity.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

@@ -47,6 +47,9 @@ DEFAULT_GLOBS = (
     # clock is INJECTED (tracing.monotonic_us lives outside this scope),
     # so the module itself names no wall clock
     "dragonboat_tpu/lifecycle.py",
+    # the capacity rail too: the compile tracker's clock is injected,
+    # flight records are stamped with call counts, never wall time
+    "dragonboat_tpu/capacity.py",
 )
 
 WALL_CLOCK = {

@@ -98,6 +98,7 @@ DEFAULT_WALK_FILES = (
 DEFAULT_ENGINE_FILES = (
     "dragonboat_tpu/engine/kernel_engine.py",
     "dragonboat_tpu/engine/mesh_engine.py",
+    "dragonboat_tpu/capacity.py",
 )
 
 #: every file any sub-check reads — scripts/lint.py --changed-only scope

@@ -185,6 +185,16 @@ class ExpertConfig:
     # default keeps chaos-replay flight tails byte-identical, since the
     # breakdown carries measured wall durations)
     trace_slow_commit_us: int = 0
+    # capacity rail (capacity.py): memory_pressure trips when headroom
+    # against the device budget drops below the watermark; budget 0 uses
+    # the backend-reported bytes_limit (and disables the trip where the
+    # backend reports none, e.g. CPU)
+    capacity_watermark_pct: float = 10.0
+    capacity_device_budget_bytes: int = 0
+    # opt into the persistent JAX compilation cache at host startup
+    # (hostenv.enable_compile_cache; DRAGONBOAT_TPU_COMPILE_CACHE=0
+    # vetoes).  Off by default: the cache dir is process-global state
+    compile_cache: bool = False
 
 
 @dataclass

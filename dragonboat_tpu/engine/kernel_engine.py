@@ -314,7 +314,9 @@ class KernelEngine:
                  fleet_stats_every: int = 10,
                  pipeline_depth: int = 0,
                  health_top_k: int = 8,
-                 health_thresholds=None) -> None:
+                 health_thresholds=None,
+                 capacity_watermark_pct: float = 10.0,
+                 capacity_budget_bytes: int = 0) -> None:
         self.kp = kp
         self.capacity = capacity
         self.send_message = send_message
@@ -434,6 +436,19 @@ class KernelEngine:
         self._health_seq = 0            # health ticks taken (flight stamp)
         _health.register_exposition(self.events.metrics.registry,
                                     lambda: self.last_health)
+        # capacity rail (dragonboat_tpu/capacity.py): compile telemetry
+        # wrappers around every jit entry this engine dispatches, plus
+        # decimated device-memory accounting on the fleet cadence
+        from dragonboat_tpu import capacity as _capacity
+
+        self.capacity_watermark_pct = float(capacity_watermark_pct)
+        self.capacity_budget_bytes = max(0, int(capacity_budget_bytes))
+        self._cap_entries = self._capacity_entries()
+        self.last_capacity: dict | None = None
+        self._capacity_seq = 0          # capacity ticks (flight stamp)
+        self._capacity_peak = 0         # high-water live tree bytes
+        _capacity.register_exposition(self.events.metrics.registry,
+                                      lambda: self.last_capacity)
 
     # -- lane lifecycle ---------------------------------------------------
 
@@ -849,6 +864,7 @@ class KernelEngine:
                     self._collect_fleet_stats()
                     if self.health_top_k > 0:
                         self._collect_health()
+                    self._collect_capacity()
             return True
 
     def _is_registered(self, n: KernelNode) -> bool:
@@ -904,7 +920,8 @@ class KernelEngine:
         exactly the state the step produced."""
         from dragonboat_tpu.core import fleet as _fleet
 
-        stats = _fleet.fleet_stats(self.state, self._fleet_inbox_from())
+        stats = self._cap_entries["fleet_stats"](
+            self.state, self._fleet_inbox_from())
         self.last_fleet = _fleet.stats_to_dict(stats)
 
     def _make_health_digest(self):
@@ -927,7 +944,7 @@ class KernelEngine:
 
         if self._health_digest is None:
             self._health_digest = self._make_health_digest()
-        report, self._health_digest = _health.fleet_health(
+        report, self._health_digest = self._cap_entries["fleet_health"](
             self.state, self._fleet_inbox_from(), self._health_digest,
             thresholds=self.health_thresholds, k=self.health_top_k)
         prev = self.last_health
@@ -943,6 +960,67 @@ class KernelEngine:
             elif n == 0 and was > 0:
                 flight.record(flight.ANOMALY_CLEARED, cls=cls,
                               tick=self._health_seq)
+
+    def _capacity_entries(self) -> dict:
+        """Compile-telemetry wrappers for every jit entry this engine
+        dispatches.  Each engine wraps independently (own counters): a
+        first compile at THIS engine's geometry is never mistaken for a
+        retrace of another engine sharing the same jitted function."""
+        from dragonboat_tpu import capacity as _capacity
+        from dragonboat_tpu.core import fleet as _fleet
+        from dragonboat_tpu.core import health as _health
+
+        return {
+            "step": _capacity.TRACKER.wrap("step", kernel_step),
+            "step_donated": _capacity.TRACKER.wrap(
+                "step_donated", kernel_step_donated),
+            "fleet_stats": _capacity.TRACKER.wrap(
+                "fleet_stats", _fleet.fleet_stats),
+            "fleet_health": _capacity.TRACKER.wrap(
+                "fleet_health", _health.fleet_health),
+        }
+
+    def _capacity_trees(self) -> tuple:
+        """Device-resident trees this engine keeps alive between steps
+        (the mesh override adds its carried inbox)."""
+        return (self.state, self._health_digest)
+
+    def _capacity_model_classes(self) -> tuple:
+        """Contract classes resident on device for this engine's
+        geometry: the single-device engine re-stages its inbox from host
+        each step, so only state + digest persist."""
+        return ("ShardState", "HealthDigest")
+
+    def _collect_capacity(self) -> None:
+        """Decimated capacity accounting, riding the fleet cadence under
+        the same engine.mu post-step window: live bytes of the resident
+        trees (shape-derived — no device sync), allocator stats where
+        the backend reports them, the contracts capacity model at this
+        geometry, and the compile counters.  The memory_pressure
+        watermark crossing is recorded as an edge-triggered flight event
+        stamped with the capacity tick — never the wall clock."""
+        from dragonboat_tpu import capacity as _capacity
+        from dragonboat_tpu import flight
+
+        live = _capacity.measure_tree_bytes(*self._capacity_trees())
+        self._capacity_seq += 1
+        self._capacity_peak = max(self._capacity_peak, live)
+        prev = self.last_capacity
+        cur = _capacity.engine_snapshot(
+            self.kp, self.capacity, live, self._capacity_peak,
+            {name: w.stats() for name, w in self._cap_entries.items()},
+            budget_bytes=self.capacity_budget_bytes,
+            watermark_pct=self.capacity_watermark_pct,
+            ticks=self._capacity_seq,
+            classes=self._capacity_model_classes())
+        self.last_capacity = cur
+        was = bool(prev and prev["memory_pressure"])
+        if cur["memory_pressure"] and not was:
+            flight.record(flight.MEMORY_PRESSURE,
+                          bytes_in_use=cur["bytes_in_use"],
+                          budget_bytes=cur["budget_bytes"],
+                          headroom_pct=cur["headroom_pct"],
+                          tick=self._capacity_seq)
 
     def health_row(self, lane: int) -> dict:
         """One lane's drill-down row (NodeHost.shard_info): an O(1)
@@ -965,10 +1043,10 @@ class KernelEngine:
             # allocations.  After this call the host must not read the
             # passed-in state again — step_all's retire-before-dispatch
             # order upholds that
-            return kernel_step_donated(self.kp, self.state,
-                                       inbox.to_device(), inp.to_device())
-        return kernel_step(self.kp, self.state, inbox.to_device(),
-                           inp.to_device())
+            return self._cap_entries["step_donated"](
+                self.kp, self.state, inbox.to_device(), inp.to_device())
+        return self._cap_entries["step"](
+            self.kp, self.state, inbox.to_device(), inp.to_device())
 
     # -- staging ----------------------------------------------------------
 

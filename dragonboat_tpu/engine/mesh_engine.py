@@ -49,7 +49,7 @@ from dragonboat_tpu.engine.kernel_engine import (
     _LaneInit,
 )
 from dragonboat_tpu.logger import get_logger
-from dragonboat_tpu.parallel.ici import IciCluster, ici_serve_step
+from dragonboat_tpu.parallel.ici import IciCluster
 
 _LOG = get_logger("mesh_engine")
 
@@ -68,7 +68,9 @@ class MeshEngine(KernelEngine):
                  events=None, fleet_stats_every: int = 10,
                  pipeline_depth: int = 0,
                  health_top_k: int = 8,
-                 health_thresholds=None) -> None:
+                 health_thresholds=None,
+                 capacity_watermark_pct: float = 10.0,
+                 capacity_budget_bytes: int = 0) -> None:
         devs = jax.devices()
         need = spec.g_size * spec.replicas
         if len(devs) < need:
@@ -86,7 +88,9 @@ class MeshEngine(KernelEngine):
                          fleet_stats_every=fleet_stats_every,
                          pipeline_depth=pipeline_depth,
                          health_top_k=health_top_k,
-                         health_thresholds=health_thresholds)
+                         health_thresholds=health_thresholds,
+                         capacity_watermark_pct=capacity_watermark_pct,
+                         capacity_budget_bytes=capacity_budget_bytes)
         # replica ids are fixed by the mesh addressing (route() targets
         # rid 1..R); rows keep them even while ABSENT
         rids = np.empty((total,), np.int32)
@@ -236,6 +240,24 @@ class MeshEngine(KernelEngine):
 
         return self.cluster.shard(_health.empty_digest(self.capacity))
 
+    def _capacity_entries(self) -> dict:
+        # the mesh dispatches through the jitted serve-step (the base
+        # step/step_donated wrappers stay registered but see no calls)
+        from dragonboat_tpu import capacity as _capacity
+        from dragonboat_tpu.parallel import ici as _ici
+
+        entries = super()._capacity_entries()
+        entries["ici_serve_step"] = _capacity.TRACKER.wrap(
+            "ici_serve_step", _ici._jit_serve_step)
+        return entries
+
+    def _capacity_trees(self) -> tuple:
+        # the carried inbox is device-resident between steps here
+        return super()._capacity_trees() + (self.box,)
+
+    def _capacity_model_classes(self) -> tuple:
+        return super()._capacity_model_classes() + ("Inbox",)
+
     def _kernel_call(self, inbox, inp):
         """Advance the mesh: host-staged inputs, device-routed messages.
         The host inbox builder is ignored — kernel-family traffic for
@@ -245,8 +267,8 @@ class MeshEngine(KernelEngine):
         staged = cl.shard(inp.to_device())
         if self._cut_dev is None:
             self._cut_dev = cl.shard(jax.numpy.asarray(self._cut))
-        state, box, out, pending = ici_serve_step(
-            cl, self.state, self.box, staged, self._cut_dev)
+        state, box, out, pending = self._cap_entries["ici_serve_step"](
+            cl.kp, cl, self.state, self.box, staged, self._cut_dev)
         self.box = box
         # keep the pending count device-side; the next _device_pending
         # call syncs it (after staging has already overlapped the step)
@@ -365,7 +387,9 @@ def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
                        events=None, fleet_stats_every: int = 10,
                        pipeline_depth: int = 0,
                        health_top_k: int = 8,
-                       health_thresholds=None) -> MeshEngine:
+                       health_thresholds=None,
+                       capacity_watermark_pct: float = 10.0,
+                       capacity_budget_bytes: int = 0) -> MeshEngine:
     with _REG_MU:
         eng = _REGISTRY.get(spec.name)
         if eng is None:
@@ -375,7 +399,9 @@ def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
                              fleet_stats_every=fleet_stats_every,
                              pipeline_depth=pipeline_depth,
                              health_top_k=health_top_k,
-                             health_thresholds=health_thresholds)
+                             health_thresholds=health_thresholds,
+                             capacity_watermark_pct=capacity_watermark_pct,
+                             capacity_budget_bytes=capacity_budget_bytes)
             _REGISTRY[spec.name] = eng
         else:
             if eng.spec != spec:

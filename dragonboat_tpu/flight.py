@@ -30,6 +30,8 @@ EVICTION = "eviction"
 SLOW_COMMIT = "slow_commit"
 ANOMALY_RAISED = "anomaly_raised"
 ANOMALY_CLEARED = "anomaly_cleared"
+RETRACE_STORM = "retrace_storm"
+MEMORY_PRESSURE = "memory_pressure"
 
 
 class FlightRecorder:

@@ -97,3 +97,25 @@ def jax_cache_dir(prefix: str = "/tmp/dragonboat_tpu_jax_cache") -> str:
     except OSError:
         pass
     return f"{prefix}_{hashlib.md5(line.encode()).hexdigest()[:8]}"
+
+
+def enable_compile_cache(
+    min_compile_secs: float = 1.0,
+    prefix: str = "/tmp/dragonboat_tpu_jax_cache",
+) -> str | None:
+    """Point jax at the persistent compilation cache (feature-
+    fingerprinted dir from ``jax_cache_dir``), so multi-rung geometry
+    sweeps and repeated script runs stop paying full recompiles.
+
+    ``DRAGONBOAT_TPU_COMPILE_CACHE=0`` vetoes (returns None).  Imports
+    jax lazily — this module must stay import-safe under a wedged
+    tunnel.  Returns the cache dir when enabled."""
+    if os.environ.get("DRAGONBOAT_TPU_COMPILE_CACHE", "1") == "0":
+        return None
+    import jax
+
+    cache_dir = jax_cache_dir(prefix)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return cache_dir

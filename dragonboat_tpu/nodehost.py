@@ -169,6 +169,11 @@ class NodeHost:
 
         _health.register_exposition(self.events.metrics.registry,
                                     self._health_snapshot, replace=True)
+        # merged capacity view (capacity.py), same ownership protocol
+        from dragonboat_tpu import capacity as _capacity
+
+        _capacity.register_exposition(self.events.metrics.registry,
+                                      self._capacity_snapshot, replace=True)
         # a directly-injected ILogDB object cannot be reopened by
         # restart() (no recipe to rebuild it); factories can
         self._injected_logdb = logdb is not None
@@ -247,6 +252,15 @@ class NodeHost:
         _lifecycle.TRACER.configure(
             sample_every=nhconfig.expert.trace_sample_every,
             slow_commit_us=nhconfig.expert.trace_slow_commit_us)
+        # opt-in persistent jit compile cache (hostenv): geometry sweeps
+        # and restarts stop paying full recompiles
+        if nhconfig.expert.compile_cache:
+            from dragonboat_tpu import hostenv as _hostenv
+
+            cache_dir = _hostenv.enable_compile_cache()
+            if cache_dir:
+                _LOG.info("NodeHost %s: persistent jax compile cache at %s",
+                          nhconfig.raft_address, cache_dir)
         # opt-in Prometheus /metrics endpoint (enable_metrics): serves
         # this host's registry + the process-global one (module-scoped
         # producers like the logdb latency histograms live there)
@@ -260,7 +274,8 @@ class NodeHost:
                 address=nhconfig.metrics_address or "127.0.0.1:0",
                 health_source=self._health_snapshot,
                 info_source=self.info,
-                shard_info_source=self._shard_info_or_none)
+                shard_info_source=self._shard_info_or_none,
+                capacity_source=self._capacity_snapshot)
             _LOG.info("NodeHost %s metrics endpoint on %s",
                       nhconfig.raft_address, self._metrics_server.address)
         self._auto_run = auto_run
@@ -331,6 +346,21 @@ class NodeHost:
                     base["leaderless_now"] += 1
             except Exception:
                 base["leaderless_now"] += 1   # torn down mid-scrape
+        return base
+
+    def _capacity_snapshot(self) -> dict:
+        """Scrape-time capacity view: the engines' cached decimated
+        capacity snapshots merged, compile entries tagged by engine.
+        Host-resident replicas hold no device state — only the engines
+        contribute."""
+        from dragonboat_tpu import capacity as _capacity
+
+        base = _capacity.empty_dict()
+        for name, eng in (("kernel", self.kernel_engine),
+                          ("mesh", self.mesh_engine)):
+            d = getattr(eng, "last_capacity", None)
+            if d:
+                _capacity.merge_into(base, d, engine=name)
         return base
 
     def _start_engine_threads(self) -> None:
@@ -641,7 +671,9 @@ class NodeHost:
                 fleet_stats_every=ex.fleet_stats_every,
                 pipeline_depth=ex.kernel_pipeline_depth,
                 health_top_k=ex.health_top_k,
-                health_thresholds=self._health_thresholds())
+                health_thresholds=self._health_thresholds(),
+                capacity_watermark_pct=ex.capacity_watermark_pct,
+                capacity_budget_bytes=ex.capacity_device_budget_bytes)
             self.kernel_engine.on_evict = self._on_kernel_evict
         init = self._build_lane_init(node, members)
         self._inject_into_engine(self.kernel_engine, node, init,
@@ -755,7 +787,11 @@ class NodeHost:
                     fleet_stats_every=self.config.expert.fleet_stats_every,
                     pipeline_depth=self.config.expert.kernel_pipeline_depth,
                     health_top_k=self.config.expert.health_top_k,
-                    health_thresholds=self._health_thresholds())
+                    health_thresholds=self._health_thresholds(),
+                    capacity_watermark_pct=(
+                        self.config.expert.capacity_watermark_pct),
+                    capacity_budget_bytes=(
+                        self.config.expert.capacity_device_budget_bytes))
             except Exception as e:
                 # not enough devices, or geometry mismatch with an
                 # already-attached engine
@@ -1503,6 +1539,7 @@ class NodeHost:
             "node_host_id": nhi.node_host_id,
             "raft_address": nhi.raft_address,
             "health": self._health_snapshot(),
+            "capacity": self._capacity_snapshot(),
             "shards": shards,
         }
 

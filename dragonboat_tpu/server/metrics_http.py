@@ -9,12 +9,18 @@ proposal spans as Chrome-trace-event JSON, loadable directly in
 Perfetto / chrome://tracing — ``/healthz``, and the fleet-health
 drill-down pair ``/debug/groups`` (NodeHost.info(): health summary +
 NodeHostInfo-parity shard list) and ``/debug/group/<id>``
-(NodeHost.shard_info(): one group's O(1) device row + host registers).
+(NodeHost.shard_info(): one group's O(1) device row + host registers),
+and ``/debug/capacity`` (capacity.py merged snapshot: live/peak bytes,
+headroom, per-entry compile counters).  ``/trace`` merges the compile
+tracker's spans into the lifecycle ring's, so one Perfetto timeline
+shows proposals beside the compiles that stalled them.
 
 ``/healthz`` is honest: with a ``health_source`` wired (core/health.py
 merged snapshot), any nonzero anomaly-class count turns it into a 503
-with a structured JSON body naming the tripped classes; without one it
-keeps the legacy unconditional ``ok``.
+with a structured JSON body naming the tripped classes; a
+``capacity_source`` reporting memory pressure or a retrace storm
+degrades it the same way (with a ``capacity`` section in the body);
+without either it keeps the legacy unconditional ``ok``.
 
 A ``ThreadingHTTPServer`` on a daemon thread: scrapes never run on an
 engine thread, and the collect path takes no registry lock while
@@ -43,17 +49,28 @@ class MetricsServer:
     def __init__(self, registries, address: str = "127.0.0.1:0",
                  flight_recorder=None, tracer=None,
                  health_source=None, info_source=None,
-                 shard_info_source=None) -> None:
+                 shard_info_source=None, capacity_source=None,
+                 compile_tracker=None) -> None:
         self.registries = list(registries)
         self.flight_recorder = (flight_recorder if flight_recorder
                                 is not None else flight.RECORDER)
         self.tracer = tracer if tracer is not None else lifecycle.TRACER
         # health_source() -> health dict (core/health.py empty_dict
         # shape); info_source() -> NodeHost.info() dict;
-        # shard_info_source(shard_id) -> dict | None
+        # shard_info_source(shard_id) -> dict | None;
+        # capacity_source() -> capacity dict (capacity.py empty_dict
+        # shape) — serves /debug/capacity and widens /healthz
         self.health_source = health_source
         self.info_source = info_source
         self.shard_info_source = shard_info_source
+        self.capacity_source = capacity_source
+        if compile_tracker is None:
+            # imported here, not at module top: capacity.py pulls jax,
+            # which importers of this module must not pay for eagerly
+            from dragonboat_tpu import capacity as _capacity
+
+            compile_tracker = _capacity.TRACKER
+        self.compile_tracker = compile_tracker
         host, _, port = address.rpartition(":")
         if not host:
             host, port = address or "127.0.0.1", "0"
@@ -71,12 +88,22 @@ class MetricsServer:
                             + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/trace":
-                    body = (json.dumps(outer.tracer.export_chrome_trace(),
-                                       sort_keys=True)
+                    # one timeline: proposal spans beside compile spans
+                    # (distinct pid rows in Perfetto / chrome://tracing)
+                    trace = outer.tracer.export_chrome_trace()
+                    trace["traceEvents"] = (
+                        list(trace.get("traceEvents", ()))
+                        + outer.compile_tracker.chrome_events())
+                    body = (json.dumps(trace, sort_keys=True)
                             + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":
                     status, body, ctype = outer.healthz()
+                elif path == "/debug/capacity" and outer.capacity_source:
+                    body = (json.dumps(outer.capacity_source(),
+                                       sort_keys=True)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/debug/groups" and outer.info_source:
                     body = (json.dumps(outer.info_source(), sort_keys=True)
                             + "\n").encode("utf-8")
@@ -122,20 +149,34 @@ class MetricsServer:
 
     def healthz(self) -> tuple[int, bytes, str]:
         """(status, body, content-type) for /healthz: degraded (503 +
-        structured JSON) when any anomaly-class count is nonzero."""
-        if self.health_source is None:
-            return 200, b"ok\n", "text/plain"
-        h = self.health_source()
-        counts = h.get("class_count", {})
+        structured JSON) when any anomaly-class count is nonzero, or
+        when the capacity view reports memory pressure / a retrace
+        storm."""
+        h = (self.health_source() if self.health_source is not None
+             else None)
+        counts = h.get("class_count", {}) if h else {}
         tripped = {c: n for c, n in counts.items() if n}
-        if not tripped:
+        cap = (self.capacity_source() if self.capacity_source is not None
+               else None)
+        cap_tripped = [k for k in ("memory_pressure", "retrace_storm")
+                       if cap and cap.get(k)]
+        if not tripped and not cap_tripped:
             return 200, b"ok\n", "text/plain"
-        body = json.dumps({
+        payload = {
             "status": "degraded",
             "class_count": counts,
-            "anomalous": h.get("anomalous", 0),
-            "worst": h.get("worst", []),
-        }, sort_keys=True) + "\n"
+            "anomalous": h.get("anomalous", 0) if h else 0,
+            "worst": h.get("worst", []) if h else [],
+        }
+        if cap_tripped:
+            payload["capacity"] = {
+                "tripped": cap_tripped,
+                "headroom_pct": cap["headroom_pct"],
+                "bytes_in_use": cap["bytes_in_use"],
+                "budget_bytes": cap["budget_bytes"],
+                "entries": cap["entries"],
+            }
+        body = json.dumps(payload, sort_keys=True) + "\n"
         return 503, body.encode("utf-8"), "application/json"
 
     def render(self) -> str:

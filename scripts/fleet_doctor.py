@@ -19,8 +19,15 @@ books, logdb range, breaker states, gossip ShardView).  ``--json``
 prints the validated payload verbatim, so the output round-trips
 against the endpoint byte-for-byte.
 
-Exit status: 0 healthy, 1 degraded (any anomaly class nonzero), 2
-unreachable or schema-invalid.  Stdlib-only on the wire (urllib).
+When the payload carries a ``capacity`` section (capacity.py merged
+snapshot), the report adds a capacity block — live/peak bytes, headroom
+against the device budget, the contracts-model prediction, and the
+per-entry compile/retrace counters — and memory pressure or a retrace
+storm counts as degraded alongside the anomaly classes.
+
+Exit status: 0 healthy, 1 degraded (any anomaly class nonzero, memory
+pressure, or a retrace storm), 2 unreachable or schema-invalid.
+Stdlib-only on the wire (urllib).
 """
 
 from __future__ import annotations
@@ -47,6 +54,41 @@ def _fmt_counts(counts: dict) -> str:
     return " ".join(f"{c}={counts[c]}" for c in health.CLASS_NAMES)
 
 
+def _capacity_degraded(cap: dict) -> list[str]:
+    return [k for k in ("memory_pressure", "retrace_storm") if cap.get(k)]
+
+
+def render_capacity(cap: dict) -> list[str]:
+    """Capacity block lines for a validated capacity snapshot."""
+    flags = _capacity_degraded(cap)
+    mb = 1024.0 * 1024.0
+    lines = [
+        f"capacity: {'DEGRADED (' + ' '.join(flags) + ')' if flags else 'OK'}"
+        f"  ticks={cap['ticks']} groups={cap['capacity']}",
+        f"  bytes: live={cap['bytes_in_use'] / mb:.2f}MiB"
+        f" peak={cap['bytes_peak'] / mb:.2f}MiB"
+        f" budget={cap['budget_bytes'] / mb:.2f}MiB"
+        f" headroom={cap['headroom_pct']:.1f}%",
+        f"  model: per_group={cap['model_bytes_per_group']}B"
+        f" predicted={cap['model_predicted_bytes'] / mb:.2f}MiB"
+        f" max_g_at_budget={cap['model_max_g_at_budget']}",
+    ]
+    if cap["entries"]:
+        lines.append("  compile entries:")
+        hdr = ("entry", "calls", "compiles", "retraces", "compile_ms")
+        rows = [hdr]
+        for name in sorted(cap["entries"]):
+            e = cap["entries"][name]
+            rows.append((name, str(e["calls"]), str(e["compiles"]),
+                         str(e["retraces"]),
+                         f"{e['compile_us_total'] / 1000.0:.1f}"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(hdr))]
+        for r in rows:
+            lines.append("    " + "  ".join(
+                v.ljust(widths[i]) for i, v in enumerate(r)).rstrip())
+    return lines
+
+
 def render_groups(info: dict) -> str:
     """Human triage report for a validated NodeHost.info() payload."""
     h = info["health"]
@@ -71,6 +113,8 @@ def render_groups(info: dict) -> str:
         for r in rows:
             lines.append("    " + "  ".join(
                 v.ljust(widths[i]) for i, v in enumerate(r)).rstrip())
+    if "capacity" in info:
+        lines.extend(render_capacity(info["capacity"]))
     lines.append(f"shards ({len(info['shards'])}):")
     for s in sorted(info["shards"], key=lambda s: s["shard_id"]):
         lead = ("leader" if s["is_leader"]
@@ -146,6 +190,12 @@ def main() -> int:
             health.validate_shard_info(obj)
         else:
             health.validate_info(obj)
+            if "capacity" in obj:
+                # lazy: capacity.py pulls jax; the pure-health path
+                # must stay scrapeable even under a wedged backend
+                from dragonboat_tpu.capacity import validate_capacity
+
+                validate_capacity(obj["capacity"], where="info.capacity")
     except ValueError as e:
         print(f"error: schema validation failed: {e}", file=sys.stderr)
         return 2
@@ -159,7 +209,8 @@ def main() -> int:
     if args.shard is not None:
         degraded = bool(obj["device"] and obj["device"]["classes"])
     else:
-        degraded = any(obj["health"]["class_count"].values())
+        degraded = (any(obj["health"]["class_count"].values())
+                    or bool(_capacity_degraded(obj.get("capacity", {}))))
     return 1 if degraded else 0
 
 

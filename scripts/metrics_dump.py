@@ -11,12 +11,19 @@ raw text or a flat JSON object.
     python scripts/metrics_dump.py 127.0.0.1:9090 --json
     python scripts/metrics_dump.py 127.0.0.1:9090 --flight
     python scripts/metrics_dump.py 127.0.0.1:9090 --doctor
+    python scripts/metrics_dump.py 127.0.0.1:9090 --capacity
     python scripts/metrics_dump.py 127.0.0.1:9090 --trace > trace.json
 
 ``--doctor`` scrapes /debug/groups — the fleet-health drill-down
 (NodeHost.info(): merged anomaly snapshot + NodeHostInfo-parity shard
 list) — and strictly validates it against the core/health.py schema
 before printing (see scripts/fleet_doctor.py for the human report).
+
+``--capacity`` scrapes /debug/capacity — the merged capacity snapshot
+(capacity.py: live/peak bytes, headroom, contracts-model prediction,
+per-entry compile/retrace counters) — strictly validated against the
+capacity schema; exit 1 when memory pressure or a retrace storm is
+flagged, so CI can gate on it.
 
 ``--trace`` scrapes /trace — the proposal-lifecycle spans as
 Chrome-trace-event JSON — and validates it strictly
@@ -63,6 +70,11 @@ def main() -> int:
     ap.add_argument("--doctor", action="store_true",
                     help="dump /debug/groups (fleet-health drill-down) "
                          "instead of /metrics, strictly schema-validated")
+    ap.add_argument("--capacity", action="store_true",
+                    help="dump /debug/capacity (capacity snapshot: bytes, "
+                         "headroom, compile counters) instead of /metrics, "
+                         "strictly schema-validated; exit 1 on memory "
+                         "pressure or retrace storm")
     ap.add_argument("--no-validate", action="store_true",
                     help="skip strict validation (exposition parsing / "
                          "Chrome-trace checks)")
@@ -71,7 +83,8 @@ def main() -> int:
 
     path = ("/trace" if args.trace
             else "/flight" if args.flight
-            else "/debug/groups" if args.doctor else "/metrics")
+            else "/debug/groups" if args.doctor
+            else "/debug/capacity" if args.capacity else "/metrics")
     try:
         text = fetch(args.address, path, args.timeout)
     except (urllib.error.URLError, OSError) as e:
@@ -116,6 +129,32 @@ def main() -> int:
                 return 1
             print(f"ok: {n} shard(s)", file=sys.stderr)
         print(json.dumps(obj, indent=2, sort_keys=True))
+        return 0
+
+    if args.capacity:
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            print(f"error: /debug/capacity is not valid JSON: {e}",
+                  file=sys.stderr)
+            return 1
+        if not args.no_validate:
+            from dragonboat_tpu.capacity import validate_capacity
+
+            try:
+                validate_capacity(obj)
+            except ValueError as e:
+                print(f"error: /debug/capacity schema validation failed: "
+                      f"{e}", file=sys.stderr)
+                return 1
+            print(f"ok: {len(obj['entries'])} compile entrie(s)",
+                  file=sys.stderr)
+        print(json.dumps(obj, indent=2, sort_keys=True))
+        degraded = [k for k in ("memory_pressure", "retrace_storm")
+                    if obj.get(k)]
+        if degraded:
+            print(f"degraded: {' '.join(degraded)}", file=sys.stderr)
+            return 1
         return 0
 
     if args.flight:

@@ -11,7 +11,12 @@ Measures, with real allocations rather than projections:
      staging scan time, and staging time under a proposal wave.
 
 Each phase prints one JSON line (PHASE_A / PHASE_B); partial runs still
-yield data.  Run on an idle box: `python scripts/scale_100k.py [--groups N]`.
+yield data.  Both rungs carry the capacity triple —
+``predicted_bytes`` (contracts-derived model, capacity.py),
+``measured_bytes`` (live tree bytes), ``max_g_at_budget`` (largest G
+fitting the device HBM limit / SCALE_BUDGET_BYTES) — so a sweep shows
+the model tracking reality rung by rung.  Run on an idle box:
+`python scripts/scale_100k.py [--groups N]`.
 """
 
 import json
@@ -33,13 +38,31 @@ STEPS = int(os.environ.get("SCALE_STEPS", "5"))
 def _enable_compile_cache() -> None:
     """Persistent compile cache keyed at capacity shapes: the 100k-lane
     step executable compiled once per box (the r4 measurement paid a
-    479 s first-step compile on every run)."""
-    import jax
+    479 s first-step compile on every run).  Counts artifacts BEFORE
+    enabling so the log line says whether this run starts cold or rides
+    a warm cache."""
+    from dragonboat_tpu import hostenv
 
-    from dragonboat_tpu.hostenv import jax_cache_dir
+    try:
+        artifacts = len(os.listdir(hostenv.jax_cache_dir()))
+    except OSError:
+        artifacts = 0
+    cache_dir = hostenv.enable_compile_cache()
+    if cache_dir is None:
+        print("SCALE compile_cache: vetoed "
+              "(DRAGONBOAT_TPU_COMPILE_CACHE=0)", flush=True)
+    else:
+        print(f"SCALE compile_cache: {'warm' if artifacts else 'cold'} "
+              f"({artifacts} artifact(s)) dir={cache_dir}", flush=True)
 
-    jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+def _budget_bytes(capacity_mod) -> int:
+    """Device HBM limit when the backend reports one, else the
+    SCALE_BUDGET_BYTES env (default 16 GiB — one v5e core)."""
+    for row in capacity_mod.device_memory_stats():
+        if row.get("bytes_limit"):
+            return int(row["bytes_limit"])
+    return int(os.environ.get("SCALE_BUDGET_BYTES", str(16 << 30)))
 
 
 def rss_gb() -> float:
@@ -52,6 +75,7 @@ def phase_a() -> None:
 
     _enable_compile_cache()
 
+    from dragonboat_tpu import capacity
     from dragonboat_tpu.bench_loop import bench_params, make_cluster, run_steps
     from dragonboat_tpu.core.kstate import empty_inbox
 
@@ -61,8 +85,15 @@ def phase_a() -> None:
     box = empty_inbox(kp, state.term.shape[0])
     jax.block_until_ready(state.term)
     build_s = time.time() - t0
-    state_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
-    box_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(box))
+    # contracts-derived model vs what the trees actually hold: the two
+    # must agree (test_capacity pins <1%); the rung records both
+    lanes = int(state.term.shape[0])
+    classes = ("ShardState", "Inbox")
+    predicted = capacity.predict_bytes(kp, lanes, classes)
+    state_bytes = capacity.measure_tree_bytes(state)
+    box_bytes = capacity.measure_tree_bytes(box)
+    budget = _budget_bytes(capacity)
+    max_g = capacity.max_g_for_budget(kp, budget, classes)
     # iters is a static jit arg: warm the EXACT executable we measure
     t0 = time.time()
     state, box = run_steps(kp, 3, STEPS, True, True, state, box)
@@ -78,6 +109,9 @@ def phase_a() -> None:
         "build_s": round(build_s, 1),
         "state_mb": round(state_bytes / 1e6, 1),
         "inbox_mb": round(box_bytes / 1e6, 1),
+        "predicted_bytes": predicted,
+        "measured_bytes": state_bytes + box_bytes,
+        "max_g_at_budget": max_g,
         "compile_s": round(compile_s, 1),
         "step_ms": round(dt / STEPS * 1e3, 1),
         "rss_gb": round(rss_gb(), 2),
@@ -194,8 +228,21 @@ def phase_b() -> None:
     eng.step_all()
     wave_steps_s = time.time() - stage_t0
     committed = int(np.asarray(eng.state.committed)[:n_shards].sum())
+    # same model the engine's /debug/capacity serves: classes + trees
+    # come from the engine so the rung and the endpoint can't diverge
+    from dragonboat_tpu import capacity
+
+    classes = eng._capacity_model_classes()
+    predicted = capacity.predict_bytes(
+        eng.kp, int(eng.state.term.shape[0]), classes)
+    measured = capacity.measure_tree_bytes(*eng._capacity_trees())
+    max_g = capacity.max_g_for_budget(
+        eng.kp, _budget_bytes(capacity), classes)
     print("PHASE_B " + json.dumps({
         "shards": n_shards,
+        "predicted_bytes": predicted,
+        "measured_bytes": measured,
+        "max_g_at_budget": max_g,
         "admit_per_s": round(admit_rate),
         "bytes_per_lane_host_books": round(bytes_per_lane),
         "rss_gb": round(rss_gb(), 2),

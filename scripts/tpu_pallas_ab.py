@@ -29,10 +29,20 @@ sys.path.insert(0, REPO)
 import jax
 import jax.numpy as jnp
 
-from dragonboat_tpu.hostenv import jax_cache_dir
+from dragonboat_tpu import hostenv
 
-jax.config.update("jax_compilation_cache_dir", jax_cache_dir())
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# shared persistent-cache helper (hostenv): same fingerprinted dir as
+# scale_100k.py, vetoable via DRAGONBOAT_TPU_COMPILE_CACHE=0
+try:
+    _CACHE_ARTIFACTS = len(os.listdir(hostenv.jax_cache_dir()))
+except OSError:
+    _CACHE_ARTIFACTS = 0
+_CACHE_DIR = hostenv.enable_compile_cache()
+print("PALLAS_AB compile_cache: "
+      + ("vetoed (DRAGONBOAT_TPU_COMPILE_CACHE=0)" if _CACHE_DIR is None
+         else f"{'warm' if _CACHE_ARTIFACTS else 'cold'} "
+              f"({_CACHE_ARTIFACTS} artifact(s)) dir={_CACHE_DIR}"),
+      flush=True)
 
 OUT = os.path.join(REPO, "PERF_TPU.jsonl")
 
