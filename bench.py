@@ -57,7 +57,10 @@ overhead of proposal-lifecycle tracing at default 1/64 sampling on the
 full serving path — see run_trace_ab), BENCH_CAPACITY=1 (standalone
 mode: interleaved A-B overhead of the capacity rail — compile-tracker
 wrappers + tree-bytes walk + snapshot assembly — on top of the
-stats+health path — see run_capacity_ab).
+stats+health path — see run_capacity_ab), BENCH_SAFETY=1 (standalone
+mode: interleaved A-B overhead of the runtime invariant probe —
+check_invariants + digest carry + O(NI) report fetch — on top of the
+stats+health path — see run_safety_ab).
 """
 
 import json
@@ -1128,6 +1131,98 @@ def run_health_ab() -> None:
     })
 
 
+def run_safety_ab() -> None:
+    """BENCH_SAFETY=1: interleaved A-B overhead of the runtime
+    invariant probe (core/invariants.py) on top of the fleet_stats +
+    fleet_health production path, at the engine's decimation cadence.
+
+    Arm A is the pre-probe production path: the bench loop in
+    ``every``-step launches plus one fleet_stats and one fleet_health
+    call + fetch per launch.  Arm B adds exactly what
+    KernelEngine._collect_invariants adds — one jitted
+    ``check_invariants`` call carrying the InvariantDigest between
+    launches, plus its O(NI) report fetch.  Arms interleave A,B,A,B,...
+    (median-of-3 per arm) so box drift lands on both.  Knobs:
+    BENCH_SAFETY_GROUPS (default 10000), BENCH_SAFETY_STEPS (120),
+    BENCH_SAFETY_EVERY (10)."""
+    import jax
+
+    from dragonboat_tpu.bench_loop import (
+        bench_params,
+        elect_all,
+        make_cluster,
+        run_steps,
+    )
+    from dragonboat_tpu.core import fleet, health, invariants
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_SAFETY_GROUPS", "10000"))
+    steps = int(os.environ.get("BENCH_SAFETY_STEPS", "120"))
+    every = max(1, int(os.environ.get("BENCH_SAFETY_EVERY", "10")))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    state, box = elect_all(kp, replicas, state)
+    num_lanes = int(state.term.shape[0])
+    h_digest = health.empty_digest(num_lanes)
+    i_digest = invariants.empty_digest(num_lanes)
+    violations_seen = 0
+
+    def window(with_probe: bool) -> float:
+        nonlocal state, box, h_digest, i_digest, violations_seen
+        t0 = time.time()
+        done = 0
+        while done < steps:
+            state, box = run_steps(kp, replicas, every, True, True,
+                                   state, box)
+            done += every
+            fleet.stats_to_dict(fleet.fleet_stats(state, box.from_))
+            h_report, h_digest = health.fleet_health(state, box.from_,
+                                                     h_digest)
+            health.report_to_dict(h_report)
+            if with_probe:
+                i_report, i_digest = invariants.check_invariants(
+                    state, i_digest)
+                violations_seen += invariants.report_to_dict(
+                    i_report)["total"]
+        state.term.block_until_ready()
+        return time.time() - t0
+
+    # warm all executables (run_steps, fleet_stats, fleet_health,
+    # check_invariants) outside the timed windows
+    window(True)
+    a_walls, b_walls = [], []
+    for _ in range(3):
+        a_walls.append(window(False))
+        b_walls.append(window(True))
+    a = sorted(a_walls)[1]
+    b = sorted(b_walls)[1]
+    overhead_pct = (b - a) / a * 100.0
+    emit({
+        "metric": (f"invariant-probe step-latency overhead, {g} groups "
+                   f"x {replicas} replicas, decimation N={every}"),
+        "value": round(overhead_pct, 2),
+        "unit": "% vs stats+health step",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "replicas": replicas,
+            "steps_per_arm_window": steps,
+            "decimation_every": every,
+            "plain_wall_s": [round(x, 3) for x in a_walls],
+            "probe_wall_s": [round(x, 3) for x in b_walls],
+            "plain_step_ms": round(a / steps * 1e3, 3),
+            "probe_step_ms": round(b / steps * 1e3, 3),
+            "num_invariants": invariants.NUM_INVARIANTS,
+            # the probed windows double as a scaled safety check: a
+            # healthy 10k-group bench cluster must stay violation-free
+            "violations_seen": int(violations_seen),
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def run_capacity_ab() -> None:
     """BENCH_CAPACITY=1: interleaved A-B overhead of the capacity rail
     (capacity.py) on top of the fleet_stats + fleet_health production
@@ -1584,6 +1679,14 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SAFETY") == "1":
+        try:
+            run_safety_ab()
+        except Exception:
+            import traceback
+
+            fail("safety-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_CAPACITY") == "1":
         try:
             run_capacity_ab()

@@ -68,6 +68,7 @@ CONTRACT_FILES = (
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/kernel.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/core/invariants.py",
 )
 PARAMS_FILE = "dragonboat_tpu/core/params.py"
 
@@ -1317,6 +1318,16 @@ def runtime_check(kp=None, num_shards: int = _CHECK_SHARDS,
         _health._shard_row_impl, state, box.from_, digest,
         jax.ShapeDtypeStruct((), jnp.int32))
     diff("ShardRow", row)
+
+    # invariant-probe structures: NI is the declared invariant count
+    from dragonboat_tpu.core import invariants as _invariants
+
+    axis_env["NI"] = _invariants.NUM_INVARIANTS
+    inv_digest = _invariants.empty_digest(G)
+    inv_report, new_inv_digest = jax.eval_shape(
+        _invariants._check_invariants_impl, state, inv_digest)
+    diff("InvariantReport", inv_report)
+    diff("InvariantDigest", new_inv_digest)
     return findings
 
 

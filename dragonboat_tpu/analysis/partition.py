@@ -78,11 +78,13 @@ DEFAULT_CONTRACT_FILES = (
     "dragonboat_tpu/core/kernel.py",
     "dragonboat_tpu/core/fleet.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/core/invariants.py",
 )
 #: files interpreted at mesh level (G axis real) — see module docstring
 DEFAULT_ANALYSIS_FILES = (
     "dragonboat_tpu/core/fleet.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/core/invariants.py",
     "dragonboat_tpu/parallel/ici.py",
 )
 DEFAULT_CONST_FILES = ("dragonboat_tpu/core/params.py",)
@@ -94,6 +96,7 @@ DEFAULT_WALK_FILES = (
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/fleet.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/core/invariants.py",
 )
 DEFAULT_ENGINE_FILES = (
     "dragonboat_tpu/engine/kernel_engine.py",
@@ -118,6 +121,7 @@ PART_BINDINGS = {
     "inp": "StepInput",
     "out": "StepOutput",
     "digest": "HealthDigest",
+    "inv_digest": "InvariantDigest",
 }
 
 #: jax.lax named collectives — using one IS declaring cross-device flow
@@ -158,6 +162,7 @@ CACHE_SOURCES = (
     "dragonboat_tpu/core/params.py",
     "dragonboat_tpu/core/fleet.py",
     "dragonboat_tpu/core/health.py",
+    "dragonboat_tpu/core/invariants.py",
     "dragonboat_tpu/parallel/ici.py",
     "dragonboat_tpu/analysis/partition.py",
 )

@@ -79,11 +79,12 @@ AXIS_PARAMS = {
 #: contract classes with a leading-G per-group footprint.  HealthReport /
 #: ShardRow are replicated O(K)/O(1) aggregates — not per-group cost
 MODEL_CLASSES = ("ShardState", "Inbox", "StepInput", "StepOutput",
-                 "HealthDigest")
+                 "HealthDigest", "InvariantDigest")
 
 #: resident set: trees an engine holds for its lifetime (StepInput /
 #: StepOutput are per-step transients) — the default for budget math
-RESIDENT_CLASSES = ("ShardState", "Inbox", "HealthDigest")
+RESIDENT_CLASSES = ("ShardState", "Inbox", "HealthDigest",
+                    "InvariantDigest")
 
 
 def _optional_materialized(cls: str, fld: str, kp) -> bool:
@@ -99,10 +100,12 @@ def _optional_materialized(cls: str, fld: str, kp) -> bool:
 def _contract_table():
     from dragonboat_tpu.analysis.common import parse_contracts
     from dragonboat_tpu.core import health as _health
+    from dragonboat_tpu.core import invariants as _invariants
     from dragonboat_tpu.core import kstate as _kstate
 
     table = dict(_kstate.CONTRACTS)
     table["HealthDigest"] = _health.CONTRACTS["HealthDigest"]
+    table["InvariantDigest"] = _invariants.CONTRACTS["InvariantDigest"]
     return parse_contracts(table, "capacity")
 
 

@@ -30,6 +30,10 @@ class OracleReport:
     # RUNNER (this module stays pure — no clocks, no global recorder
     # reads — so the determinism lint keeps covering it)
     flight_tail: list = field(default_factory=list)
+    # runtime invariant-probe counters harvested from every live host
+    # after the final heal (attached by the runner; see
+    # check_invariant_probe for the verdict over them)
+    invariant_probe: dict = field(default_factory=dict)
 
     def fail(self, msg: str) -> None:
         self.ok = False
@@ -103,6 +107,23 @@ def check_hashes_equal(name: str, hashes: dict) -> OracleReport:
     if len(set(hashes.values())) > 1:
         rep.fail(f"{name} hashes diverge: " + ", ".join(
             f"r{rid}={hashes[rid]:#x}" for rid in sorted(hashes)))
+    return rep
+
+
+def check_invariant_probe(counters: dict) -> OracleReport:
+    """The device-side invariant probe must stay silent through a whole
+    chaos schedule — faults may delay commits, but no interleaving of
+    crashes/partitions/delays is allowed to produce a protocol-invariant
+    violation (``violations_seen`` is sticky per engine lifetime, so a
+    one-tick trip during the fault window still fails here)."""
+    rep = OracleReport()
+    seen = int(counters.get("violations_seen", 0))
+    live = int(counters.get("total", 0))
+    if seen or live:
+        first = counters.get("first")
+        rep.fail(f"invariant probe tripped during the schedule: "
+                 f"violations_seen={seen} live_total={live}"
+                 + (f", first offender: {first}" if first else ""))
     return rep
 
 

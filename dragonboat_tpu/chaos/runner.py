@@ -25,7 +25,8 @@ from random import Random
 from dragonboat_tpu import flight
 from dragonboat_tpu.chaos.crashfs import CrashPointFS
 from dragonboat_tpu.chaos.faultplan import FaultPlan, canonical_json
-from dragonboat_tpu.chaos.oracle import OracleReport, check_convergence
+from dragonboat_tpu.chaos.oracle import (OracleReport, check_convergence,
+                                         check_invariant_probe)
 from dragonboat_tpu.config import (
     Config,
     ExpertConfig,
@@ -206,6 +207,21 @@ class _Cluster:
             snap = nh.events.metrics.snapshot()
             total += int(snap.get("health.leaderless_now", 0))
         return total
+
+    def invariant_counters(self) -> dict:
+        """Invariant-probe counters merged across every live host's
+        engines (the same `_invariants_snapshot` view a scrape reads).
+        ``violations_seen`` is sticky per engine lifetime, so a
+        transient mid-schedule trip survives to this harvest."""
+        from dragonboat_tpu.core import invariants as _invariants
+
+        base = _invariants.empty_dict()
+        base["violations_seen"] = 0
+        for rid in self.live_rids():
+            d = self.hosts[rid]._invariants_snapshot()
+            _invariants.merge_into(base, d, engine=f"r{rid}")
+            base["violations_seen"] += int(d.get("violations_seen", 0))
+        return base
 
     # -- event execution -------------------------------------------------
 
@@ -458,6 +474,12 @@ def run_schedule(seed: int, plan: FaultPlan | None = None,
             if leaderless:
                 report.fail(f"health.leaderless_now gauge stuck at "
                             f"{leaderless} after convergence")
+        # 3. the runtime invariant probe stayed silent: no interleaving
+        #    of faults may produce a protocol-invariant violation.  The
+        #    harvested counters ride the report either way, so every
+        #    schedule's verdict records what the probe observed.
+        report.invariant_probe = cluster.invariant_counters()
+        report.merge(check_invariant_probe(report.invariant_probe))
         if not report.ok:
             # attach the flight-recorder tail so a failure report carries
             # the recent structured transitions (leader changes, trips,

@@ -173,6 +173,13 @@ class ExpertConfig:
     health_lag_ticks: int = 3
     health_churn_trip: int = 8
     health_runaway_ticks: int = 4
+    # runtime protocol-invariant probe (core/invariants.py): rides the
+    # fleet_stats_every decimation, evaluating the declared
+    # core/kstate.py INVARIANTS over every group and fetching one O(1)
+    # verdict report.  Any violation is a BUG (kernel or declaration):
+    # it raises an invariant_violation flight event and degrades
+    # /healthz.  False disables the pass
+    invariant_probe: bool = True
     # proposal-lifecycle tracing (lifecycle.py): every Nth proposal key
     # carries an end-to-end span stamped at each host hop (propose,
     # stage, dispatch, retire, save, fsync, apply, ack) and feeds the

@@ -315,6 +315,7 @@ class KernelEngine:
                  pipeline_depth: int = 0,
                  health_top_k: int = 8,
                  health_thresholds=None,
+                 invariant_probe: bool = True,
                  capacity_watermark_pct: float = 10.0,
                  capacity_budget_bytes: int = 0) -> None:
         self.kp = kp
@@ -436,6 +437,27 @@ class KernelEngine:
         self._health_seq = 0            # health ticks taken (flight stamp)
         _health.register_exposition(self.events.metrics.registry,
                                     lambda: self.last_health)
+        # decimated protocol-invariant probe (core/invariants.py): the
+        # runtime leg of the safety verifier, riding the same fleet
+        # countdown.  The prev-field digest carry stays device resident;
+        # one O(1) InvariantReport crosses to host.  A violation is
+        # ALWAYS a bug, so sightings are sticky (violations_seen) — a
+        # transient step-scope violation must not vanish from /healthz
+        # at the next clean window
+        from dragonboat_tpu.core import invariants as _invariants
+
+        self.invariant_probe = bool(invariant_probe)
+        self._inv_digest = None         # built lazily at the first tick
+        self.last_invariants: dict | None = None
+        self._inv_seq = 0               # probe ticks taken (flight stamp)
+        self._inv_violations_seen = 0   # sticky cumulative violation total
+        # lanes injected/cleared since the last probe tick: their digest
+        # prev-columns describe a DIFFERENT occupant, so the probe must
+        # re-seed them (ticks=0) or a fresh shard's lower term would
+        # read as a bogus term_monotone violation
+        self._inv_dirty: set[int] = set()
+        _invariants.register_exposition(self.events.metrics.registry,
+                                        lambda: self.last_invariants)
         # capacity rail (dragonboat_tpu/capacity.py): compile telemetry
         # wrappers around every jit entry this engine dispatches, plus
         # decimated device-memory accounting on the fleet cadence
@@ -507,6 +529,7 @@ class KernelEngine:
         self._lead_term_np[lane] = 0
         self._occ_np[lane] = True
         self._pending_inject[lane] = (node, init, pids, kinds)
+        self._inv_dirty.add(lane)
         self.mark_dirty(lane)
 
     def _flush_injections(self) -> None:
@@ -624,6 +647,7 @@ class KernelEngine:
         )
 
     def _clear_lane(self, lane: int) -> None:
+        self._inv_dirty.add(lane)
         if self._pending_inject.pop(lane, None) is not None:
             # evicted before its injection ever flushed: the lane state
             # was never written, so there is nothing to clear on device
@@ -864,6 +888,8 @@ class KernelEngine:
                     self._collect_fleet_stats()
                     if self.health_top_k > 0:
                         self._collect_health()
+                    if self.invariant_probe:
+                        self._collect_invariants()
                     self._collect_capacity()
             return True
 
@@ -961,6 +987,50 @@ class KernelEngine:
                 flight.record(flight.ANOMALY_CLEARED, cls=cls,
                               tick=self._health_seq)
 
+    def _make_invariant_digest(self):
+        """Fresh all-zero invariant digest matching the engine's lane
+        geometry; the mesh override shards it along G."""
+        from dragonboat_tpu.core import invariants as _invariants
+
+        return _invariants.empty_digest(self.capacity)
+
+    def _collect_invariants(self) -> None:
+        """Decimated protocol-invariant probe (core/invariants.py), on
+        the same cadence (and under the same engine.mu post-step window)
+        as ``_collect_fleet_stats``.  Lanes whose occupant changed since
+        the last probe tick are re-seeded (ticks=0) so step-scoped
+        invariants never compare across occupants.  A 0 -> nonzero
+        violation edge is recorded as an ``invariant_violation`` flight
+        event stamped with the probe-tick sequence — never the wall
+        clock."""
+        from dragonboat_tpu import flight
+        from dragonboat_tpu.core import invariants as _invariants
+
+        if self._inv_digest is None:
+            self._inv_digest = self._make_invariant_digest()
+        if self._inv_dirty:
+            lanes = jnp.asarray(
+                np.array(sorted(self._inv_dirty), np.int32))
+            self._inv_dirty.clear()
+            d = self._inv_digest
+            self._inv_digest = d._replace(ticks=d.ticks.at[lanes].set(0))
+        report, self._inv_digest = self._cap_entries["check_invariants"](
+            self.state, self._inv_digest)
+        prev = self.last_invariants
+        cur = _invariants.report_to_dict(report)
+        self._inv_seq += 1
+        self._inv_violations_seen += cur["total"]
+        cur["violations_seen"] = self._inv_violations_seen
+        self.last_invariants = cur
+        was = prev["total"] if prev else 0
+        if cur["total"] > 0 and was == 0:
+            first = cur["first"] or {}
+            flight.record(flight.INVARIANT_VIOLATION,
+                          total=cur["total"],
+                          lane=first.get("lane", -1),
+                          invariants=first.get("invariants", []),
+                          tick=self._inv_seq)
+
     def _capacity_entries(self) -> dict:
         """Compile-telemetry wrappers for every jit entry this engine
         dispatches.  Each engine wraps independently (own counters): a
@@ -969,6 +1039,7 @@ class KernelEngine:
         from dragonboat_tpu import capacity as _capacity
         from dragonboat_tpu.core import fleet as _fleet
         from dragonboat_tpu.core import health as _health
+        from dragonboat_tpu.core import invariants as _invariants
 
         return {
             "step": _capacity.TRACKER.wrap("step", kernel_step),
@@ -978,18 +1049,20 @@ class KernelEngine:
                 "fleet_stats", _fleet.fleet_stats),
             "fleet_health": _capacity.TRACKER.wrap(
                 "fleet_health", _health.fleet_health),
+            "check_invariants": _capacity.TRACKER.wrap(
+                "check_invariants", _invariants.check_invariants),
         }
 
     def _capacity_trees(self) -> tuple:
         """Device-resident trees this engine keeps alive between steps
         (the mesh override adds its carried inbox)."""
-        return (self.state, self._health_digest)
+        return (self.state, self._health_digest, self._inv_digest)
 
     def _capacity_model_classes(self) -> tuple:
         """Contract classes resident on device for this engine's
         geometry: the single-device engine re-stages its inbox from host
-        each step, so only state + digest persist."""
-        return ("ShardState", "HealthDigest")
+        each step, so only state + digests persist."""
+        return ("ShardState", "HealthDigest", "InvariantDigest")
 
     def _collect_capacity(self) -> None:
         """Decimated capacity accounting, riding the fleet cadence under

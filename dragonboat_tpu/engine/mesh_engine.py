@@ -69,6 +69,7 @@ class MeshEngine(KernelEngine):
                  pipeline_depth: int = 0,
                  health_top_k: int = 8,
                  health_thresholds=None,
+                 invariant_probe: bool = True,
                  capacity_watermark_pct: float = 10.0,
                  capacity_budget_bytes: int = 0) -> None:
         devs = jax.devices()
@@ -89,6 +90,7 @@ class MeshEngine(KernelEngine):
                          pipeline_depth=pipeline_depth,
                          health_top_k=health_top_k,
                          health_thresholds=health_thresholds,
+                         invariant_probe=invariant_probe,
                          capacity_watermark_pct=capacity_watermark_pct,
                          capacity_budget_bytes=capacity_budget_bytes)
         # replica ids are fixed by the mesh addressing (route() targets
@@ -240,6 +242,13 @@ class MeshEngine(KernelEngine):
 
         return self.cluster.shard(_health.empty_digest(self.capacity))
 
+    def _make_invariant_digest(self):
+        # same sharding story as the health digest: per-row part=G
+        from dragonboat_tpu.core import invariants as _invariants
+
+        return self.cluster.shard(
+            _invariants.empty_digest(self.capacity))
+
     def _capacity_entries(self) -> dict:
         # the mesh dispatches through the jitted serve-step (the base
         # step/step_donated wrappers stay registered but see no calls)
@@ -388,6 +397,7 @@ def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
                        pipeline_depth: int = 0,
                        health_top_k: int = 8,
                        health_thresholds=None,
+                       invariant_probe: bool = True,
                        capacity_watermark_pct: float = 10.0,
                        capacity_budget_bytes: int = 0) -> MeshEngine:
     with _REG_MU:
@@ -400,6 +410,7 @@ def attach_mesh_engine(kp: KP.KernelParams, spec: MeshSpec,
                              pipeline_depth=pipeline_depth,
                              health_top_k=health_top_k,
                              health_thresholds=health_thresholds,
+                             invariant_probe=invariant_probe,
                              capacity_watermark_pct=capacity_watermark_pct,
                              capacity_budget_bytes=capacity_budget_bytes)
             _REGISTRY[spec.name] = eng

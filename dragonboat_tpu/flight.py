@@ -32,6 +32,7 @@ ANOMALY_RAISED = "anomaly_raised"
 ANOMALY_CLEARED = "anomaly_cleared"
 RETRACE_STORM = "retrace_storm"
 MEMORY_PRESSURE = "memory_pressure"
+INVARIANT_VIOLATION = "invariant_violation"
 
 
 class FlightRecorder:

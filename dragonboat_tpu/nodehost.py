@@ -169,6 +169,15 @@ class NodeHost:
 
         _health.register_exposition(self.events.metrics.registry,
                                     self._health_snapshot, replace=True)
+        # merged protocol-invariant view (core/invariants.py), same
+        # ownership protocol.  Host-resident replicas contribute nothing
+        # (the probe is a device reduction); the merged view exists so a
+        # violation on EITHER engine degrades this host's /healthz
+        from dragonboat_tpu.core import invariants as _invariants
+
+        _invariants.register_exposition(self.events.metrics.registry,
+                                        self._invariants_snapshot,
+                                        replace=True)
         # merged capacity view (capacity.py), same ownership protocol
         from dragonboat_tpu import capacity as _capacity
 
@@ -275,7 +284,8 @@ class NodeHost:
                 health_source=self._health_snapshot,
                 info_source=self.info,
                 shard_info_source=self._shard_info_or_none,
-                capacity_source=self._capacity_snapshot)
+                capacity_source=self._capacity_snapshot,
+                invariants_source=self._invariants_snapshot)
             _LOG.info("NodeHost %s metrics endpoint on %s",
                       nhconfig.raft_address, self._metrics_server.address)
         self._auto_run = auto_run
@@ -346,6 +356,25 @@ class NodeHost:
                     base["leaderless_now"] += 1
             except Exception:
                 base["leaderless_now"] += 1   # torn down mid-scrape
+        return base
+
+    def _invariants_snapshot(self) -> dict:
+        """Scrape-time protocol-invariant view: the engines' cached O(1)
+        probe reports merged (first offender tagged by engine).  The
+        probe is device-side only — host-resident replicas contribute
+        nothing.  A nonzero ``violations_seen`` is sticky for each
+        engine's lifetime: /healthz stays degraded after a transient
+        step-scope violation (it is a bug either way)."""
+        from dragonboat_tpu.core import invariants as _invariants
+
+        base = _invariants.empty_dict()
+        base["violations_seen"] = 0
+        for name, eng in (("kernel", self.kernel_engine),
+                          ("mesh", self.mesh_engine)):
+            d = getattr(eng, "last_invariants", None)
+            if d:
+                _invariants.merge_into(base, d, engine=name)
+                base["violations_seen"] += d.get("violations_seen", 0)
         return base
 
     def _capacity_snapshot(self) -> dict:
@@ -672,6 +701,7 @@ class NodeHost:
                 pipeline_depth=ex.kernel_pipeline_depth,
                 health_top_k=ex.health_top_k,
                 health_thresholds=self._health_thresholds(),
+                invariant_probe=ex.invariant_probe,
                 capacity_watermark_pct=ex.capacity_watermark_pct,
                 capacity_budget_bytes=ex.capacity_device_budget_bytes)
             self.kernel_engine.on_evict = self._on_kernel_evict
@@ -788,6 +818,7 @@ class NodeHost:
                     pipeline_depth=self.config.expert.kernel_pipeline_depth,
                     health_top_k=self.config.expert.health_top_k,
                     health_thresholds=self._health_thresholds(),
+                    invariant_probe=self.config.expert.invariant_probe,
                     capacity_watermark_pct=(
                         self.config.expert.capacity_watermark_pct),
                     capacity_budget_bytes=(

@@ -50,7 +50,7 @@ class MetricsServer:
                  flight_recorder=None, tracer=None,
                  health_source=None, info_source=None,
                  shard_info_source=None, capacity_source=None,
-                 compile_tracker=None) -> None:
+                 compile_tracker=None, invariants_source=None) -> None:
         self.registries = list(registries)
         self.flight_recorder = (flight_recorder if flight_recorder
                                 is not None else flight.RECORDER)
@@ -64,6 +64,11 @@ class MetricsServer:
         self.info_source = info_source
         self.shard_info_source = shard_info_source
         self.capacity_source = capacity_source
+        # invariants_source() -> invariants dict (core/invariants.py
+        # empty_dict shape + violations_seen) — widens /healthz: a
+        # protocol-invariant violation is a BUG, so the degradation is
+        # sticky (violations_seen, not the instantaneous total)
+        self.invariants_source = invariants_source
         if compile_tracker is None:
             # imported here, not at module top: capacity.py pulls jax,
             # which importers of this module must not pay for eagerly
@@ -149,9 +154,11 @@ class MetricsServer:
 
     def healthz(self) -> tuple[int, bytes, str]:
         """(status, body, content-type) for /healthz: degraded (503 +
-        structured JSON) when any anomaly-class count is nonzero, or
-        when the capacity view reports memory pressure / a retrace
-        storm."""
+        structured JSON) when any anomaly-class count is nonzero, when
+        the capacity view reports memory pressure / a retrace storm, or
+        when the invariant probe has EVER seen a protocol-invariant
+        violation (sticky — a violation is a bug, not a condition that
+        clears)."""
         h = (self.health_source() if self.health_source is not None
              else None)
         counts = h.get("class_count", {}) if h else {}
@@ -160,7 +167,11 @@ class MetricsServer:
                else None)
         cap_tripped = [k for k in ("memory_pressure", "retrace_storm")
                        if cap and cap.get(k)]
-        if not tripped and not cap_tripped:
+        inv = (self.invariants_source()
+               if self.invariants_source is not None else None)
+        inv_tripped = bool(inv) and (inv.get("violations_seen", 0) > 0
+                                     or inv.get("total", 0) > 0)
+        if not tripped and not cap_tripped and not inv_tripped:
             return 200, b"ok\n", "text/plain"
         payload = {
             "status": "degraded",
@@ -175,6 +186,13 @@ class MetricsServer:
                 "bytes_in_use": cap["bytes_in_use"],
                 "budget_bytes": cap["budget_bytes"],
                 "entries": cap["entries"],
+            }
+        if inv_tripped:
+            payload["invariants"] = {
+                "total": inv.get("total", 0),
+                "violations_seen": inv.get("violations_seen", 0),
+                "per_invariant": inv.get("per_invariant", {}),
+                "first": inv.get("first"),
             }
         body = json.dumps(payload, sort_keys=True) + "\n"
         return 503, body.encode("utf-8"), "application/json"

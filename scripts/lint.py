@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis runner: the six lint passes over the repo.
+"""Static-analysis runner: the seven lint passes over the repo.
 
 Passes (dragonboat_tpu/analysis/):
 
@@ -24,6 +24,13 @@ Passes (dragonboat_tpu/analysis/):
                   bodies, implicit device→host syncs in the engine hot
                   paths, and a 2-device dynamic diff of declared vs
                   actual output shardings
+  safety          Raft protocol safety: the kstate INVARIANTS
+                  declarations lint (RS001/RS006), provenance-checked
+                  store obligations on committed / vote / last in
+                  core/kernel.py (RS002-RS004), and the cached
+                  small-scope exhaustive model check of the real jitted
+                  kernel step (scripts/model_check.py fast scope,
+                  RS005)
 
 Passes run in parallel worker processes (one fork per pass; jax
 initializes per-child so the AST-only passes never pay for it).  Use
@@ -40,7 +47,9 @@ suppressed zero findings (SW002) is stale and fails the run.
 
 `--format json` emits one finding per line (JSON object with path,
 line, pass, rule, message, waived, reason) so CI can diff findings
-between commits; the default human format is unchanged.
+between commits; `--format sarif` emits a single SARIF 2.1.0 document
+(one run, one result per finding, waived findings at level=note) for
+code-scanning UIs; the default human format is unchanged.
 
 The hlo-budget pass compiles the bench kernel (~10 s on CPU) only when
 a hashed kernel source changed since the cached measurement
@@ -48,7 +57,8 @@ a hashed kernel source changed since the cached measurement
 loops with `--pass` selecting the AST passes, or refresh its budget
 after a justified kernel change with `--reseed-hlo-budget` (then
 record why in PERF.md).  The partition pass's dynamic mesh check
-caches the same way (analysis/.partition_cache.json).
+caches the same way (analysis/.partition_cache.json), as does the
+safety pass's model-check gate (analysis/.safety_cache.json).
 """
 
 from __future__ import annotations
@@ -79,6 +89,7 @@ from dragonboat_tpu.analysis import (  # noqa: E402
     determinism,
     hlo_budget,
     partition,
+    safety,
     tracer_safety,
 )
 
@@ -89,6 +100,7 @@ PASSES = {
     "hlo-budget": hlo_budget.run,
     "contracts": contracts.run,
     "partition": partition.run,
+    "safety": safety.run,
 }
 
 # repo-relative inputs of each pass, for --changed-only (entries may be
@@ -100,6 +112,7 @@ PASS_SCOPES = {
     "hlo-budget": hlo_budget.CACHE_SOURCES,
     "contracts": contracts.CONTRACT_FILES + (contracts.PARAMS_FILE,),
     "partition": partition.SCOPE,
+    "safety": safety.SCOPE,
 }
 
 WAIVERS_FILE = "dragonboat_tpu/analysis/waivers.toml"
@@ -166,8 +179,12 @@ def changed_files(root: str) -> list[str] | None:
 
 def select_changed(changed: list[str]) -> list[str]:
     """Which passes a change set touches.  Any edit to the analyzers or
-    this runner invalidates everything."""
-    if any(c.startswith("dragonboat_tpu/analysis/")
+    this runner invalidates everything — and so does a waivers.toml
+    edit (spelled out even though the analysis/ prefix covers it: a
+    changed waiver can un-suppress a finding in ANY pass, so no pass's
+    prior verdict survives it)."""
+    if any(c == WAIVERS_FILE
+           or c.startswith("dragonboat_tpu/analysis/")
            or c.startswith("scripts/lint") for c in changed):
         return sorted(PASSES)
     out = []
@@ -212,6 +229,49 @@ def run_passes(selected: list[str],
     return {name: _run_pass(name) for name in selected}
 
 
+def to_sarif(unwaived: list[common.Finding],
+             waived: list[tuple[common.Finding, common.Waiver]]) -> dict:
+    """One SARIF 2.1.0 run: rules derived from the findings, waived
+    findings downgraded to level=note with the waiver reason attached."""
+    rules: dict[str, dict] = {}
+    results = []
+    for f, reason in ([(f, None) for f in unwaived]
+                      + [(f, wv.reason) for f, wv in waived]):
+        rules.setdefault(f.rule, {
+            "id": f.rule,
+            "properties": {"pass": f.pass_name},
+            "shortDescription": {"text": f"{f.pass_name} {f.rule}"},
+        })
+        res = {
+            "ruleId": f.rule,
+            "level": "note" if reason is not None else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "properties": {"pass": f.pass_name,
+                           "waived": reason is not None},
+        }
+        if reason is not None:
+            res["properties"]["waiverReason"] = reason
+        results.append(res)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dragonboat-tpu-lint",
+                "rules": [rules[k] for k in sorted(rules)],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pass", dest="passes", action="append",
@@ -226,10 +286,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings blob on stdout "
                          "(legacy; prefer --format json)")
-    ap.add_argument("--format", choices=("human", "json"), default="human",
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human",
                     help="json = one finding per line "
                          "(path, line, pass, rule, message, waived, "
-                         "reason); default: human")
+                         "reason); sarif = one SARIF 2.1.0 document; "
+                         "default: human")
     ap.add_argument("--reseed-hlo-budget", action="store_true",
                     help="re-measure the kernel and overwrite "
                          "analysis/hlo_budget.json (justify in PERF.md)")
@@ -294,6 +356,9 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(row(f, None), sort_keys=True))
         for f, wv in waived:
             print(json.dumps(row(f, wv.reason), sort_keys=True))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(unwaived, waived), indent=2,
+                         sort_keys=True))
     elif args.json:
         print(json.dumps({
             "findings": [f.__dict__ for f in unwaived],

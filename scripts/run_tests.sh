@@ -27,9 +27,11 @@ run() {
 }
 # Lint findings are written as a JSON-lines build artifact (CI uploads
 # it; diffable between commits) and rendered as a per-check summary
-# table by scripts/lint_summary.py, which carries the pass/fail.
+# table by scripts/lint_summary.py, which carries the pass/fail.  A
+# SARIF 2.1.0 sibling is emitted alongside for code-scanning UIs.
 ARTIFACT="${LINT_ARTIFACT:-build/lint_findings.jsonl}"
-mkdir -p "$(dirname "$ARTIFACT")"
+SARIF_ARTIFACT="${LINT_SARIF_ARTIFACT:-build/lint_findings.sarif}"
+mkdir -p "$(dirname "$ARTIFACT")" "$(dirname "$SARIF_ARTIFACT")"
 lint() {
     # $@ = extra scripts/lint.py args; rc 2+ (waiver/parse errors) must
     # not be masked by an empty artifact looking clean
@@ -40,6 +42,10 @@ lint() {
         echo "lint runner error (rc=$lint_rc)"
         return "$lint_rc"
     fi
+    # second emission is cheap: every dynamic check is cache-warm from
+    # the json run one line up
+    PYTHONPATH= "$PY" scripts/lint.py --format sarif "$@" \
+        > "$SARIF_ARTIFACT" || true
     PYTHONPATH= "$PY" scripts/lint_summary.py "$ARTIFACT"
 }
 # `run_tests.sh lint-fast`: the tight-edit-loop entry — only the lint
@@ -49,11 +55,12 @@ if [ "${1:-}" = "lint-fast" ]; then
     lint --changed-only
     exit $?
 fi
-# fast pre-test stage: the six static-analysis passes (scripts/lint.py;
+# fast pre-test stage: the seven static-analysis passes (scripts/lint.py;
 # ~2 s when kernel sources are unchanged — the hlo-budget compile result
 # is cached in analysis/.hlo_budget_cache.json keyed by a source hash,
 # and the partition pass's 2-device mesh check likewise in
-# analysis/.partition_cache.json — and ~12 s after a kernel edit).
+# analysis/.partition_cache.json, the safety pass's model-check gate
+# in analysis/.safety_cache.json — and ~20 s after a kernel edit).
 # After a justified kernel change that shifts the
 # gather/scatter/while counts: `python scripts/lint.py
 # --reseed-hlo-budget`, review the analysis/hlo_budget.json diff, and

@@ -64,6 +64,80 @@ def test_schedule_converges_on_pipelined_kernel():
 
 
 @pytest.mark.chaos_fast
+def test_probe_catches_commit_without_quorum_mutation(monkeypatch):
+    """Mutation acceptance for the runtime invariant probe (ISSUE 14):
+    a kernel seeded with the commit-without-quorum bug from the model
+    checker's catalogue, serving a LIVE 3-replica device-resident
+    cluster, must trip ``leader_commit_quorum`` — the flight recorder
+    carries the invariant_violation edge, ``violations_seen`` latches,
+    and /healthz degrades to 503 (stickily: a violation is a bug, not a
+    condition that clears)."""
+    import importlib.util
+    import json
+    import os
+    import sys
+    import time
+
+    from dragonboat_tpu import flight
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.engine import kernel_engine as ke
+    from dragonboat_tpu.server.metrics_http import MetricsServer
+
+    from test_kernel_engine import close_all, make_cluster, propose_retry
+    from test_nodehost import wait_leader
+
+    mc_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                           "scripts", "model_check.py")
+    spec = importlib.util.spec_from_file_location("_chaos_model_check",
+                                                  mc_path)
+    mc = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mc
+    spec.loader.exec_module(mc)
+    mut = mc.load_kernel_module("commit_without_quorum")
+    # the engine binds these module globals at construction time
+    monkeypatch.setattr(ke, "kernel_step", mut.step)
+    monkeypatch.setattr(ke, "kernel_step_donated", mut.step_donated)
+
+    hosts = make_cluster("mutq", expert=ExpertConfig(
+        kernel_log_cap=256, kernel_capacity=8, kernel_apply_batch=16,
+        kernel_compaction_overhead=16, fleet_stats_every=1))
+    server = None
+    try:
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        # keep proposing so the mutated leader path (commit = last,
+        # quorum unconsulted) keeps advancing ahead of the acks; the
+        # probe rides every step at fleet_stats_every=1
+        deadline = time.time() + 30
+        snap = nh._invariants_snapshot()
+        i = 0
+        while time.time() < deadline and not snap["violations_seen"]:
+            try:
+                propose_retry(nh, sess, f"m{i}=x".encode(), deadline_s=2)
+            except Exception:
+                pass
+            i += 1
+            snap = nh._invariants_snapshot()
+        assert snap["violations_seen"] > 0, snap
+        assert snap["per_invariant"]["leader_commit_quorum"] > 0 \
+            or snap["first"] is not None, snap
+        assert any(r.get("kind") == flight.INVARIANT_VIOLATION
+                   for r in flight.RECORDER.tail(256)), \
+            "no invariant_violation flight record"
+        server = MetricsServer(
+            [nh.events.metrics.registry],
+            invariants_source=nh._invariants_snapshot)
+        status, body, _ = server.healthz()
+        assert status == 503, (status, body)
+        assert json.loads(body)["invariants"]["violations_seen"] > 0
+    finally:
+        if server is not None:
+            server.close()
+        close_all(hosts)
+
+
+@pytest.mark.chaos_fast
 def test_schedule_trace_is_byte_identical_and_replayable():
     """The deterministic-replay contract (COVERAGE.md): the same seed
     twice yields byte-identical fault traces, and the recorded plan JSON

@@ -1,0 +1,222 @@
+"""Raft safety pass (dragonboat_tpu/analysis/safety.py): the repo's
+own kernel must be clean under every static obligation, each seeded
+protocol mutation from the model checker's catalogue must be caught by
+the rule that owns it, the RS001/RS006 declaration lint must fire on
+malformed fixtures, the model-check gate must cache by source hash, and
+the lint runner must register the seventh pass (including the explicit
+waivers.toml invalidation and the SARIF emitter)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from dragonboat_tpu.analysis import safety
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "lint_under_safety_test", os.path.join(REPO, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mutations():
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "model_check_under_safety_test",
+        os.path.join(REPO, "scripts", "model_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod.MUTATIONS
+
+
+def _mutated_root(tmp_path, find, replace):
+    """A tmp repo root holding the real kstate + a mutated kernel."""
+    core = tmp_path / "dragonboat_tpu" / "core"
+    core.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "dragonboat_tpu/core/kstate.py"),
+                core / "kstate.py")
+    src = open(os.path.join(REPO, "dragonboat_tpu/core/kernel.py")).read()
+    assert find in src, "mutation target drifted from kernel source"
+    (core / "kernel.py").write_text(src.replace(find, replace))
+    return str(tmp_path)
+
+
+# ----------------------------------------------------- repo is clean
+
+
+def test_repo_static_legs_clean():
+    assert safety.run(REPO, dynamic=False) == []
+
+
+# ------------------------------------------- seeded-mutation coverage
+# ownership: which static rule catches which protocol bug (double_vote
+# has no store-shape signature — the model checker owns it, see
+# test_model_check.py)
+
+STATIC_OWNER = {
+    "skip_vote_persist": "RS003",
+    "commit_without_quorum": "RS002",
+    "truncate_committed": "RS004",
+}
+
+
+@pytest.mark.parametrize("mutation", sorted(STATIC_OWNER))
+def test_static_rule_catches_mutation(tmp_path, mutation):
+    find, replace = _mutations()[mutation]
+    root = _mutated_root(tmp_path, find, replace)
+    rules = {f.rule for f in safety.run(root, dynamic=False)}
+    assert STATIC_OWNER[mutation] in rules, (mutation, rules)
+
+
+# ------------------------------------------------- declaration lint
+
+
+def _kstate_fixture(tmp_path, invariants_src):
+    core = tmp_path / "dragonboat_tpu" / "core"
+    core.mkdir(parents=True)
+    p = core / "kstate.py"
+    p.write_text(textwrap.dedent(f"""\
+        CONTRACTS = {{
+            "ShardState": {{
+                "committed": "i32[G] part=G",
+                "term": "i32[G] part=G",
+            }},
+        }}
+        {invariants_src}
+        """))
+    return str(p)
+
+
+def test_rs001_unparseable_invariant(tmp_path):
+    p = _kstate_fixture(
+        tmp_path, 'INVARIANTS = {"bad": "committed <=> term"}')
+    findings, parsed = safety.check_declarations(str(tmp_path), p)
+    assert [f.rule for f in findings] == ["RS001"]
+    assert parsed == {}
+
+
+def test_rs001_unknown_field(tmp_path):
+    p = _kstate_fixture(
+        tmp_path, 'INVARIANTS = {"ghost": "committed <= made_up_field"}')
+    findings, _ = safety.check_declarations(str(tmp_path), p)
+    assert [f.rule for f in findings] == ["RS001"]
+    assert "made_up_field" in findings[0].message
+
+
+def test_rs006_missing_and_empty(tmp_path):
+    p = _kstate_fixture(tmp_path, "")
+    findings, _ = safety.check_declarations(str(tmp_path), p)
+    assert [f.rule for f in findings] == ["RS006"]
+    p2 = _kstate_fixture((tmp_path / "e"), "INVARIANTS = {}")
+    findings, _ = safety.check_declarations(str(tmp_path / "e"), p2)
+    assert [f.rule for f in findings] == ["RS006"]
+
+
+def test_rs006_empty_declarations_flagged_via_run(tmp_path):
+    """run() on a fixture file set surfaces the declaration findings
+    and skips the dynamic gate."""
+    p = _kstate_fixture(tmp_path, "INVARIANTS = {}")
+    findings = safety.run(str(tmp_path), files=[p])
+    assert [f.rule for f in findings] == ["RS006"]
+
+
+# ------------------------------------------------ model-check caching
+
+
+def test_gate_cache_hit_and_source_invalidation(tmp_path, monkeypatch):
+    """A cached verdict is replayed verbatim; any hashed-source edit
+    misses.  The gate itself is monkeypatched out so this stays fast."""
+    calls = {"n": 0}
+
+    class _FakeMC:
+        @staticmethod
+        def run_scope(scope, root=None):
+            calls["n"] += 1
+            return {"scope": scope, "states_explored": 1,
+                    "transitions": 0, "frontier_exhausted": True,
+                    "scope_complete": True, "violations": []}
+
+    monkeypatch.setattr(safety, "_load_model_check", lambda root: _FakeMC)
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "dragonboat_tpu/analysis"))
+    os.makedirs(os.path.join(root, "dragonboat_tpu/core"))
+    kernel = os.path.join(root, "dragonboat_tpu/core/kernel.py")
+    open(kernel, "w").write("x = 1\n")
+
+    assert safety.model_check_gate(root) == []
+    assert calls["n"] == 1
+    assert safety.model_check_gate(root) == []
+    assert calls["n"] == 1                       # cache hit
+    open(kernel, "w").write("x = 2\n")
+    assert safety.model_check_gate(root) == []
+    assert calls["n"] == 2                       # source edit missed
+
+
+def test_gate_replays_cached_violations(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "dragonboat_tpu/analysis"))
+    key = safety._source_key(root)
+    with open(os.path.join(root, safety.CACHE_FILE), "w") as f:
+        json.dump({"key": key, "messages": ["boom"]}, f)
+    findings = safety.model_check_gate(root)
+    assert [f.rule for f in findings] == ["RS005"]
+    assert findings[0].message == "boom"
+
+
+# --------------------------------------------- lint runner integration
+
+
+def test_lint_registers_safety_pass_and_scope():
+    mod = _load_lint_module()
+    assert "safety" in mod.PASSES
+    assert "dragonboat_tpu/core/kernel.py" in mod.PASS_SCOPES["safety"]
+    assert "scripts/model_check.py" in mod.PASS_SCOPES["safety"]
+
+
+def test_changed_only_waivers_edit_invalidates_every_pass():
+    """A waivers.toml edit can un-suppress a finding in ANY pass, so it
+    must select all of them — spelled out, not left to the analysis/
+    prefix coincidence."""
+    mod = _load_lint_module()
+    assert mod.select_changed([mod.WAIVERS_FILE]) == sorted(mod.PASSES)
+    # kernel edits select the safety pass (among others in its scope)
+    assert "safety" in mod.select_changed(["dragonboat_tpu/core/kernel.py"])
+    assert mod.select_changed(["README.md"]) == []
+
+
+def test_sarif_output_shape():
+    mod = _load_lint_module()
+    common = __import__("dragonboat_tpu.analysis.common",
+                        fromlist=["Finding", "Waiver"])
+    f1 = common.Finding("safety", "dragonboat_tpu/core/kernel.py", 7,
+                        "RS002", "commit store unproven")
+    f2 = common.Finding("partition", "a.py", 1, "PS001", "leaked axis")
+    wv = common.Waiver(pass_name="partition", path="a.py", rule="PS001",
+                       reason="known", line=1)
+    doc = mod.to_sarif([f1], [(f2, wv)])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dragonboat-tpu-lint"
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rules == {"RS002", "PS001"}
+    by_rule = {r["ruleId"]: r for r in run["results"]}
+    assert by_rule["RS002"]["level"] == "error"
+    assert by_rule["RS002"]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"] == "dragonboat_tpu/core/kernel.py"
+    assert by_rule["RS002"]["locations"][0]["physicalLocation"][
+        "region"]["startLine"] == 7
+    assert by_rule["PS001"]["level"] == "note"
+    assert by_rule["PS001"]["properties"]["waiverReason"] == "known"
+    json.dumps(doc)                  # must be serializable as-is
