@@ -1678,7 +1678,176 @@ def run_cpu_subprocess(degraded_note: str | None) -> None:
         fail("cpu-fallback", f"no JSON from fallback (rc={p.returncode})")
 
 
+def run_mesh_pipeline_ab() -> None:
+    """BENCH_MESH_PIPELINE=1: A-B of the MESH dispatch path's two jit
+    entries (engine/dispatch.py MeshDispatch) under the same host
+    protocol the engine runs — serial depth-0 (non-donated
+    jit_serve_step, blocking per-step fetch) vs pipelined depth-1
+    (jit_serve_step_donated: buffers donated to XLA, host staging
+    built from one-step-stale retired copies, the pending scalar
+    consumed one step late) — at 1024 groups x 3 replicas on a
+    ('g','r') = (1, 3) host mesh.
+
+    Interleaved windows A,B,A,B,... (median-of-3 per arm, the headline
+    bench's policy); each arm reports wall, per-micro-step time and
+    committed writes/s on leader rows.  Knobs: BENCH_MESH_GROUPS
+    (default 1024), BENCH_MESH_STEPS (micro-steps per window, default
+    120)."""
+    import numpy as np
+
+    import jax
+
+    from dragonboat_tpu.bench_loop import bench_params
+    from dragonboat_tpu.core import params as KP
+    from dragonboat_tpu.core.kstate import StepInput
+    from dragonboat_tpu.parallel.ici import (
+        jit_serve_step,
+        jit_serve_step_donated,
+        make_ici_cluster,
+    )
+    from jax.sharding import Mesh
+
+    replicas = 3
+    devs = jax.devices()
+    if len(devs) < replicas:
+        raise RuntimeError(
+            f"mesh A/B needs {replicas} host devices, have {len(devs)} "
+            "(main() forces xla_force_host_platform_device_count "
+            "before jax loads — do not preimport jax)")
+    groups = int(os.environ.get("BENCH_MESH_GROUPS", "1024"))
+    micro = int(os.environ.get("BENCH_MESH_STEPS", "120"))
+    platform = devs[0].platform
+    kp = bench_params(replicas)
+    B = kp.proposal_cap
+    mesh = Mesh(np.array(devs[:replicas]).reshape(1, replicas),
+                ("g", "r"))
+    cluster, state0, box0 = make_ici_cluster(kp, mesh, groups)
+    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+
+    def host_input(role_h, proc_h, propose=True):
+        # the engine's _InputBuilder shape: staged from HOST copies, so
+        # nothing aliases the donated device buffers
+        G = role_h.shape[0]
+        lead = role_h == KP.LEADER
+        z = lambda: np.zeros((G,), np.int32)  # noqa: E731
+        return StepInput(
+            prop_valid=np.broadcast_to(
+                lead[:, None] & propose, (G, B)).copy(),
+            prop_cc=np.zeros((G, B), bool),
+            ri_valid=np.zeros((G,), bool),
+            ri_low=z(), ri_high=z(), transfer_to=z(),
+            tick=np.ones((G,), bool),
+            quiesced=np.zeros((G,), bool),
+            applied=proc_h)
+
+    # election pump: tick until every group has one leader
+    state, box = state0, box0
+    for _ in range(40):
+        role_h = np.asarray(state.role)
+        if int((role_h == KP.LEADER).sum()) >= groups:
+            break
+        inp = cluster.shard(host_input(
+            role_h, np.asarray(state.processed), propose=False))
+        state, box, _, _ = jit_serve_step(
+            kp, cluster, state, box, inp, cut)
+    lead_rows = np.asarray(state.role) == KP.LEADER
+
+    def committed(st):
+        return int(np.asarray(st.committed)[lead_rows]
+                   .astype(np.int64).sum())
+
+    arms = {"serial": {"state": state, "box": box},
+            "pipelined": {"state": state, "box": box}}
+
+    def window(arm):
+        a = arms[arm]
+        c0 = committed(a["state"])
+        t0 = time.time()
+        if arm == "serial":
+            # depth-0 protocol: stage from the CURRENT state (blocking
+            # host fetch), dispatch the non-donated oracle, consume the
+            # pending scalar immediately (the per-step blocking fetch)
+            for _ in range(micro):
+                inp = cluster.shard(host_input(
+                    np.asarray(a["state"].role),
+                    np.asarray(a["state"].processed)))
+                a["state"], a["box"], _, pending = jit_serve_step(
+                    kp, cluster, a["state"], a["box"], inp, cut)
+                int(pending)
+        else:
+            # depth-1 protocol: stage from one-step-stale retired
+            # copies (host build overlaps the in-flight device step),
+            # pull the NEXT staging copies right before dispatch hands
+            # the buffers to XLA, defer the pending sync one step.
+            # np.array (a real copy), never np.asarray: on CPU that is
+            # a zero-copy view of a buffer this arm donates away
+            role_h = np.array(a["state"].role)
+            proc_h = np.array(a["state"].processed)
+            pending_carry = None
+            for _ in range(micro):
+                inp = cluster.shard(host_input(role_h, proc_h))
+                if pending_carry is not None:
+                    int(pending_carry)
+                role_h = np.array(a["state"].role)
+                proc_h = np.array(a["state"].processed)
+                a["state"], a["box"], _, pending_carry = \
+                    jit_serve_step_donated(
+                        kp, cluster, a["state"], a["box"], inp, cut)
+            int(pending_carry)
+        a["state"].term.block_until_ready()
+        dt = time.time() - t0
+        w = committed(a["state"]) - c0
+        return {"wall_s": round(dt, 3),
+                "micro_step_ms": round(dt / micro * 1e3, 3),
+                "writes": w,
+                "writes_per_s": round(w / dt)}
+
+    for arm in arms:  # warm both executables outside the timed windows
+        window(arm)
+    wins = {"serial": [], "pipelined": []}
+    for _ in range(3):
+        for arm in ("serial", "pipelined"):
+            wins[arm].append(window(arm))
+    med = {arm: sorted(ws, key=lambda r: r["micro_step_ms"])[1]
+           for arm, ws in wins.items()}
+    speedup = (med["serial"]["micro_step_ms"]
+               / max(med["pipelined"]["micro_step_ms"], 1e-9))
+    emit({
+        "metric": ("mesh dispatch serial vs pipelined (donated), "
+                   f"{groups} groups x {replicas} replicas"),
+        "value": round(speedup, 3),
+        "unit": "x serial/pipelined micro-step time",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "mesh": f"('g','r') = (1, {replicas})",
+            "groups": groups,
+            "micro_steps_per_window": micro,
+            "serial": med["serial"],
+            "pipelined": med["pipelined"],
+            "windows": wins,
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def main() -> None:
+    if os.environ.get("BENCH_MESH_PIPELINE") == "1":
+        # must run before anything imports jax: the 3-replica mesh
+        # needs one host device per replica slot
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=3"
+            ).strip()
+        try:
+            run_mesh_pipeline_ab()
+        except Exception:
+            import traceback
+
+            fail("mesh-pipeline-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_SAFETY") == "1":
         try:
             run_safety_ab()

@@ -72,6 +72,16 @@ CONTRACT_FILES = (
 )
 PARAMS_FILE = "dragonboat_tpu/core/params.py"
 
+# modules whose donate_argnums decorations the KC008 cross-check scans:
+# kernel.py (the default module of a DONATION entry) plus every module a
+# DONATION ``module`` key may name.  scripts/lint.py folds these into
+# the contracts pass's --changed-only scope.
+DONATION_MODULES = (
+    "dragonboat_tpu/core/kernel.py",
+    "dragonboat_tpu/parallel/ici.py",
+    "dragonboat_tpu/core/router.py",
+)
+
 # KernelParams attribute -> the symbolic axis it sizes
 KP_AXIS_ATTRS = {
     "num_peers": "P",
@@ -1376,33 +1386,56 @@ def _donated_entries(tree: ast.Module) -> dict[str, tuple[tuple, list, int]]:
 
 
 def donation_check(root: str, kstate_tree: ast.Module,
-                   kernel_tree: ast.Module) -> list[Finding]:
-    """Cross-check the declared donation contract against the kernel's
-    actual ``donate_argnums`` decorations (both directions)."""
+                   kernel_tree: ast.Module,
+                   extra_trees: dict[str, ast.Module] | None = None,
+                   ) -> list[Finding]:
+    """Cross-check the declared donation contract against the actual
+    ``donate_argnums`` decorations (both directions).
+
+    Entries default to ``KERNEL_FILE``; an entry carrying a ``module``
+    key is checked against that module instead (``extra_trees`` maps
+    repo-relative module path -> parsed tree; every DONATION_MODULES
+    member beyond kernel.py should be present).  An entry's ``function``
+    key names the decorated function when it differs from the entry
+    name."""
     findings: list[Finding] = []
-    krel = rel(root, os.path.join(root, KERNEL_FILE))
     srel = rel(root, os.path.join(root, CONTRACT_FILES[0]))
     decl, decl_line = _donation_decl(kstate_tree)
-    entries = _donated_entries(kernel_tree)
+    mod_trees = {KERNEL_FILE: kernel_tree}
+    mod_trees.update(extra_trees or {})
+    mod_entries = {m: _donated_entries(t) for m, t in mod_trees.items()}
     if decl is None:
-        if entries:
+        if any(mod_entries.values()):
             findings.append(Finding(
                 PASS, srel, decl_line, "KC008",
-                "kernel.py donates buffers but kstate.py has no (or a "
+                "jit entries donate buffers but kstate.py has no (or a "
                 "non-literal) DONATION declaration"))
         return findings
+    declared_fns: dict[str, set[str]] = {m: set() for m in mod_trees}
     for name, spec in decl.items():
-        if name not in entries:
+        module = spec.get("module", KERNEL_FILE)
+        fn_name = spec.get("function", name)
+        mrel = rel(root, os.path.join(root, module))
+        entries = mod_entries.get(module)
+        if entries is None:
             findings.append(Finding(
                 PASS, srel, decl_line, "KC008",
-                f"DONATION declares {name} but kernel.py has no such "
-                "donate_argnums-decorated function"))
+                f"DONATION entry {name} names module {module} which is "
+                "not in DONATION_MODULES — the cross-check cannot see "
+                "its decorators"))
             continue
-        nums, params, line = entries[name]
+        declared_fns.setdefault(module, set()).add(fn_name)
+        if fn_name not in entries:
+            findings.append(Finding(
+                PASS, srel, decl_line, "KC008",
+                f"DONATION declares {name} but {module} has no "
+                f"donate_argnums-decorated function {fn_name}"))
+            continue
+        nums, params, line = entries[fn_name]
         want_nums = tuple(spec.get("argnums", ()))
         if nums != want_nums:
             findings.append(Finding(
-                PASS, krel, line, "KC008",
+                PASS, mrel, line, "KC008",
                 f"{name}: donate_argnums {nums} != declared "
                 f"DONATION argnums {want_nums}"))
             continue
@@ -1410,16 +1443,18 @@ def donation_check(root: str, kstate_tree: ast.Module,
         want_params = tuple(spec.get("params", ()))
         if bound != want_params:
             findings.append(Finding(
-                PASS, krel, line, "KC008",
+                PASS, mrel, line, "KC008",
                 f"{name}: donated parameters {bound} != declared "
                 f"DONATION params {want_params}"))
-    for name, (_, _, line) in entries.items():
-        if name not in decl:
-            findings.append(Finding(
-                PASS, krel, line, "KC008",
-                f"{name} donates buffers but is not declared in "
-                "kstate.DONATION — the host no-touch contract is "
-                "undocumented/unchecked"))
+    for module, entries in mod_entries.items():
+        mrel = rel(root, os.path.join(root, module))
+        for name, (_, _, line) in entries.items():
+            if name not in declared_fns.get(module, set()):
+                findings.append(Finding(
+                    PASS, mrel, line, "KC008",
+                    f"{name} donates buffers but is not declared in "
+                    "kstate.DONATION — the host no-touch contract is "
+                    "undocumented/unchecked"))
     return findings
 
 
@@ -1468,5 +1503,12 @@ def run(root: str, files: list[str] | None = None) -> list[Finding]:
         ktree = tree_of(os.path.join(root, CONTRACT_FILES[0]))
         ntree = tree_of(os.path.join(root, KERNEL_FILE))
         if ktree is not None and ntree is not None:
-            findings = findings + donation_check(root, ktree, ntree)
+            extra: dict[str, ast.Module] = {}
+            for m in DONATION_MODULES:
+                if m == KERNEL_FILE:
+                    continue
+                mt = tree_of(os.path.join(root, m))
+                if mt is not None:
+                    extra[m] = mt
+            findings = findings + donation_check(root, ktree, ntree, extra)
     return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))

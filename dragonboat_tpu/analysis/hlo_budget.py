@@ -13,6 +13,13 @@ dynamic scatter — the exact op classes that serialize over [G] or
 miscompile on TPU v5e — fails CI instead of waiting for the next
 device bench window.
 
+The same gate covers the mesh engines' dispatch graph: the ``mesh``
+budget section lowers the fused shard_map serving step
+(``parallel/ici.py jit_serve_step`` — kernel step + in-mesh routing +
+partition mask in one body) on a 2-device host mesh and holds its
+gather/scatter/while counts the same way, so neither the serial loop
+nor the collective serving body can quietly regrow per-lane ops.
+
 Counts are group-count-independent (instruction count, not instruction
 size — verified 64 vs 1024 groups), so the gate measures at a small G
 for speed.  The budget-update workflow when a kernel change
@@ -50,6 +57,8 @@ CACHE_SOURCES = (
     "dragonboat_tpu/core/kernel.py",
     "dragonboat_tpu/core/kstate.py",
     "dragonboat_tpu/core/params.py",
+    "dragonboat_tpu/core/router.py",
+    "dragonboat_tpu/parallel/ici.py",
     "dragonboat_tpu/bench_loop.py",
     "dragonboat_tpu/analysis/hlo_budget.py",
 )
@@ -97,6 +106,48 @@ def measure(groups: int = 64, replicas: int = 3, iters: int = 20,
     with tracing.annotate("lint.hlo.lower"):
         lowered = loop.lower(kp, replicas, iters, True, True,
                              state, box)
+    with tracing.annotate("lint.hlo.compile"):
+        compiled = lowered.compile()
+    return _count_ops(compiled.as_text())
+
+
+def measure_mesh(groups: int = 4, replicas: int = 2,
+                 onehot_reads: bool = True) -> dict[str, int]:
+    """Optimized-HLO op counts of the fused shard_map serving body
+    (``parallel/ici.py jit_serve_step``) — the mesh engines' dispatch
+    entry — on a CPU host mesh.
+
+    Needs ``replicas`` host devices; the lint runner forces
+    ``xla_force_host_platform_device_count=2``, so the measurement mesh
+    is ``('g','r') = (1, 2)``.  Instruction counts are group-count-
+    independent exactly like the serial loop's, so the small mesh gates
+    the same graph the 3-replica engines run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from dragonboat_tpu import tracing
+    from dragonboat_tpu.bench_loop import bench_params
+    from dragonboat_tpu.parallel import ici
+
+    devs = jax.devices()
+    if len(devs) < replicas:
+        raise RuntimeError(
+            f"mesh HLO budget needs {replicas} devices, have "
+            f"{len(devs)} — run via scripts/lint.py (it forces "
+            "xla_force_host_platform_device_count)")
+    with tracing.annotate("lint.hlo.build"):
+        kp = bench_params(replicas,
+                          platform="tpu" if onehot_reads else "cpu")
+        mesh = Mesh(np.array(devs[:replicas]).reshape(1, replicas),
+                    ("g", "r"))
+        cluster, state, box = ici.make_ici_cluster(kp, mesh, groups)
+        inp = cluster.shard(ici.self_driving_input(kp, state))
+        cut = cluster.shard(jnp.zeros((cluster.total_rows,), bool))
+    with tracing.annotate("lint.hlo.lower"):
+        lowered = ici.jit_serve_step.lower(
+            kp, cluster, state, box, inp, cut)
     with tracing.annotate("lint.hlo.compile"):
         compiled = lowered.compile()
     return _count_ops(compiled.as_text())
@@ -172,6 +223,8 @@ def run(root: str, budget_path: str | None = None,
     sections: dict[str, dict] = {"run_steps": spec.get("budget", {})}
     if "pipelined" in spec:
         sections["run_steps_pipelined"] = spec["pipelined"].get("budget", {})
+    if "mesh" in spec:
+        sections["serve_step"] = spec["mesh"].get("budget", {})
     if measured is not None:
         measured_map = {"run_steps": measured}
     else:
@@ -180,15 +233,23 @@ def run(root: str, budget_path: str | None = None,
         if cached is not None and set(sections) <= set(cached):
             measured_map = cached
         else:
-            measured_map = {
-                entry: measure(
+            mesh_cfg = spec.get("mesh", {}).get("config", {})
+
+            def _measure_entry(entry: str) -> dict[str, int]:
+                if entry == "serve_step":
+                    return measure_mesh(
+                        groups=mesh_cfg.get("groups", 4),
+                        replicas=mesh_cfg.get("replicas", 2),
+                        onehot_reads=cfg.get("onehot_reads", True))
+                return measure(
                     groups=cfg.get("groups", 64),
                     replicas=cfg.get("replicas", 3),
                     iters=cfg.get("iters", 20),
                     onehot_reads=cfg.get("onehot_reads", True),
                     entry=entry)
-                for entry in sections
-            }
+
+            measured_map = {entry: _measure_entry(entry)
+                            for entry in sections}
             _cache_store(root, key, measured_map)
     findings = []
     for entry, budget in sections.items():
@@ -220,6 +281,10 @@ def reseed(root: str, budget_path: str | None = None,
     measured_pipe = measure(groups=groups, replicas=replicas, iters=iters,
                             onehot_reads=onehot_reads,
                             entry="run_steps_pipelined")
+    mesh_groups, mesh_replicas = 4, 2
+    measured_mesh = measure_mesh(groups=mesh_groups,
+                                 replicas=mesh_replicas,
+                                 onehot_reads=onehot_reads)
     spec = {
         "config": {
             "kernel": "bench_loop.run_steps",
@@ -240,10 +305,22 @@ def reseed(root: str, budget_path: str | None = None,
                        for op in GATED_OPS},
             "observed": measured_pipe,
         },
-        "note": ("Budgets gate gather/scatter/while over BOTH traced "
-                 "loops (serial run_steps at the top level, the fused "
-                 "depth-1 run_steps_pipelined under 'pipelined'); counts "
-                 "are group-count-independent.  Update via "
+        "mesh": {
+            "kernel": "parallel/ici.py jit_serve_step (shard_map body)",
+            "config": {"groups": mesh_groups,
+                       "replicas": mesh_replicas,
+                       "mesh": "('g','r') = (1, 2)"},
+            "budget": {op.replace("-", "_"):
+                       measured_mesh[op.replace("-", "_")]
+                       for op in GATED_OPS},
+            "observed": measured_mesh,
+        },
+        "note": ("Budgets gate gather/scatter/while over every traced "
+                 "dispatch graph: serial run_steps at the top level, "
+                 "the fused depth-1 run_steps_pipelined under "
+                 "'pipelined', and the fused shard_map serving step "
+                 "(the mesh engines' dispatch entry) under 'mesh'; "
+                 "counts are group-count-independent.  Update via "
                  "scripts/lint.py --reseed-hlo-budget + a PERF.md note "
                  "justifying the change."),
     }
@@ -252,5 +329,6 @@ def reseed(root: str, budget_path: str | None = None,
         f.write("\n")
     _cache_store(root, source_hash(root, spec["config"]),
                  {"run_steps": measured,
-                  "run_steps_pipelined": measured_pipe})
+                  "run_steps_pipelined": measured_pipe,
+                  "serve_step": measured_mesh})
     return spec

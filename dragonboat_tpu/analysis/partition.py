@@ -101,6 +101,7 @@ DEFAULT_WALK_FILES = (
 DEFAULT_ENGINE_FILES = (
     "dragonboat_tpu/engine/kernel_engine.py",
     "dragonboat_tpu/engine/mesh_engine.py",
+    "dragonboat_tpu/engine/dispatch.py",
     "dragonboat_tpu/capacity.py",
 )
 
@@ -141,7 +142,7 @@ _CALLBACKS = frozenset({"pure_callback", "io_callback", "host_callback"})
 # drain probe), _collect_fleet_stats / _fleet_inbox_from (decimated).
 HOT_PATH_FUNCS = frozenset({
     "step_all", "mark_dirty", "_kernel_call", "_stage_lane",
-    "_stage_props", "_prop_target",
+    "_stage_props", "_prop_target", "dispatch",
 })
 #: self.<attr> values that live on device in both engines
 _DEVICE_SELF_ATTRS = frozenset({"state", "box", "_pending_dev", "_cut_dev"})
@@ -150,6 +151,8 @@ _DEVICE_PRODUCERS = frozenset({
     "kernel_step", "kernel_step_donated", "step", "step_donated",
     "ici_serve_step", "ici_cluster_step", "fleet_stats",
     "fleet_health", "shard_row",
+    "jit_serve_step", "jit_serve_step_donated",
+    "cluster_step", "cluster_step_donated", "dispatch",
     "output_row_flags", "to_device", "shard", "device_put", "_kernel_call",
 })
 

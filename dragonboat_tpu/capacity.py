@@ -7,8 +7,9 @@ the step kernel mid-flight) and HBM exhaustion (a geometry that fits
 analytically but OOMs in practice).  Three legs make both observable:
 
 - **Compile telemetry** (:class:`CompileTracker`): every jit entry the
-  engines dispatch (``step``, ``step_donated``, ``fleet_stats``,
-  ``fleet_health``, ``ici_serve_step``, bench loops) is wrapped in a
+  engines dispatch (``step``, ``step_donated``, ``serve_step``,
+  ``serve_step_donated``, ``fleet_stats``, ``fleet_health``, bench
+  loops) is wrapped in a
   tracked callable that detects a trace/compile by sampling the jitted
   function's executable-cache size around each call.  Each compile is
   counted per entry, timed (the call's wall time is trace+lower+compile

@@ -244,12 +244,15 @@ INVARIANTS = {
 # ---------------------------------------------------------------------------
 # Buffer-donation contract (checked by analysis/contracts.py, KC008).
 #
-# Each entry names a jitted entry point in core/kernel.py that donates
-# argument buffers to XLA and records WHICH positional arguments (and the
-# parameter names they bind) are donated.  The analyzer parses kernel.py's
-# decorators and fails lint if the ``donate_argnums`` there drifts from
-# this declaration — so the host-side rule below is always describing the
-# real kernel, not a stale comment.
+# Each entry names a jitted entry point that donates argument buffers to
+# XLA and records WHICH positional arguments (and the parameter names
+# they bind) are donated.  Entries default to core/kernel.py; an entry
+# with a ``module`` key declares a donating entry elsewhere (the mesh
+# serve step in parallel/ici.py, the router differential twin).  The
+# analyzer parses each module's decorators and fails lint if the
+# ``donate_argnums`` there drifts from this declaration — so the
+# host-side rule below is always describing the real kernel, not a
+# stale comment.
 #
 # Host rule implied by donation: after dispatching a donated entry point
 # the caller MUST NOT read or re-pass the donated argument arrays — XLA
@@ -271,6 +274,26 @@ DONATION = {
         # result class.
         "donor_classes": ("ShardState", "Inbox", "StepInput"),
         "result_classes": ("ShardState", "StepOutput"),
+    },
+    "serve_step_donated": {
+        # the mesh dispatch entry: state, the carried device inbox and
+        # the staged input are donated; the partition mask (argnum 5) is
+        # cached across steps by the engine and must NOT be donated
+        "module": "dragonboat_tpu/parallel/ici.py",
+        "function": "jit_serve_step_donated",
+        "argnums": (2, 3, 4),
+        "params": ("state", "box", "inp"),
+        "donor_classes": ("ShardState", "Inbox", "StepInput"),
+        "result_classes": ("ShardState", "Inbox", "StepOutput"),
+    },
+    "cluster_step_donated": {
+        # router-layout twin used by the depth-1 differential arm: same
+        # donation triple as step_donated, fused with device routing
+        "module": "dragonboat_tpu/core/router.py",
+        "argnums": (2, 3, 4),
+        "params": ("state", "inbox", "inp"),
+        "donor_classes": ("ShardState", "Inbox", "StepInput"),
+        "result_classes": ("ShardState", "Inbox", "StepOutput"),
     },
 }
 

@@ -257,3 +257,16 @@ def cluster_step(kp: KP.KernelParams, replicas: int, state: ShardState,
     state, out = step(kp, state, inbox, inp)
     nxt = route(kp, replicas, out)
     return state, nxt, out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
+def cluster_step_donated(kp: KP.KernelParams, replicas: int,
+                         state: ShardState, inbox: Inbox, inp: StepInput):
+    """Donating twin of ``cluster_step`` (kstate.DONATION
+    ``cluster_step_donated``): state, inbox and input hand their buffers
+    to XLA, so after dispatch the caller must only read the RETURNED
+    state/inbox/out — the depth-1 differential arm's retire-before-
+    dispatch order (tests/test_engine_differential.py) upholds that."""
+    state, out = step(kp, state, inbox, inp)
+    nxt = route(kp, replicas, out)
+    return state, nxt, out

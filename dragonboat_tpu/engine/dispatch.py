@@ -1,0 +1,258 @@
+"""Unified engine dispatch: ONE step loop, two jit backends.
+
+PR 6 gave the single-device engine donation + depth-1 software
+pipelining; the mesh engine's bespoke ``_kernel_call`` never caught up
+(no donation, no pipelined entry, its own telemetry wiring) — the exact
+engine-layer drift the engine-unity lint pass (analysis/engine_unity.py,
+EU001–EU006) now makes a failure.  This module is the refactor that
+makes the repo clean: ``KernelEngine.step_all`` remains the ONLY step
+loop, and the only thing a backend contributes is a ``dispatch()`` —
+serial jit (core/kernel.py ``step``/``step_donated``) or the
+``parallel/ici.py`` shard_map serving entries — each exposed as a
+donated + non-donated pair behind CompileTracker telemetry, so the
+pipelined retire-before-dispatch protocol and the masked output fetch
+work identically on both paths.
+
+The module-level tuples/dicts below are the MACHINE-READ contract the
+engine-unity pass enforces (pure literals, parsed with
+``ast.literal_eval`` — like kstate's CONTRACTS/DONATION tables):
+
+- ``STEP_LOOP_METHODS``: step-loop internals only ``STEP_LOOP_OWNER``
+  may define — a subclass override is a second step loop (EU001);
+- ``DISPATCH_SEAMS``: the sanctioned subclass seams (addressing,
+  membership, escalation, message emission, and ``_make_dispatch``);
+- ``ENGINE_FEATURE_KNOBS`` / ``ENGINE_FEATURE_CALLS``: dispatch
+  features that must be reachable from ``step_all`` on every engine
+  path (EU002/EU004);
+- ``DISPATCH_ENTRIES``: every jit entry a dispatch backend may call —
+  donated ones must carry a kstate.DONATION declaration (EU003,
+  composing with KC008/PS004), non-donated ones a waiver naming why.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dragonboat_tpu import capacity as _capacity
+from dragonboat_tpu.core import params as KP
+from dragonboat_tpu.core.kernel import (
+    step as kernel_step,
+    step_donated as kernel_step_donated,
+)
+from dragonboat_tpu.core.kstate import empty_inbox
+from dragonboat_tpu.parallel.ici import (
+    IciCluster,
+    jit_serve_step,
+    jit_serve_step_donated,
+)
+
+#: the one class allowed to define step-loop internals
+STEP_LOOP_OWNER = "KernelEngine"
+
+#: step-loop internals: defining any of these in a subclass of the owner
+#: is a second step-loop implementation (EU001)
+STEP_LOOP_METHODS = (
+    "step_all",
+    "_flush_injections",
+    "_stage_lane",
+    "_stage_props",
+    "_process_outputs",
+    "_kernel_call",
+    "_capacity_entries",
+    "_device_pending",
+    "_fleet_inbox_from",
+    "_capacity_trees",
+    "_capacity_model_classes",
+    "_make_health_digest",
+    "_make_invariant_digest",
+)
+
+#: sanctioned subclass seams: addressing, membership, escalation,
+#: host-side message emission, and the dispatch-backend factory
+DISPATCH_SEAMS = (
+    "_make_dispatch",
+    "_emit_messages",
+    "_prop_target",
+    "_mirror_floor",
+    "_is_registered",
+    "_evict",
+    "add_shard",
+    "remove_shard",
+    "update_lane_membership",
+)
+
+#: ExpertConfig-fed engine attributes gating dispatch features; every
+#: one must be read on a path reachable from step_all in EVERY concrete
+#: engine (EU002 flags per-path drift)
+ENGINE_FEATURE_KNOBS = (
+    "pipeline_depth",
+    "fleet_stats_every",
+    "health_top_k",
+    "invariant_probe",
+)
+
+#: feature calls (not attributes) that must stay reachable from the
+#: step loop on every path — the masked output fetch is gated on the
+#: [G, C] activity matrix this produces
+ENGINE_FEATURE_CALLS = ("output_row_flags",)
+
+#: every jit entry a dispatch backend may call.  ``donated`` entries
+#: must be kstate.DONATION-declared (EU003 cross-checks via KC008);
+#: non-donated entries carry a waiver naming why donation is out.
+DISPATCH_ENTRIES = {
+    "step": {
+        "module": "dragonboat_tpu/core/kernel.py",
+        "function": "step",
+        "donated": False,
+        "waiver": "depth-0 serial oracle: the differential reference "
+                  "entry must leave its inputs readable",
+    },
+    "step_donated": {
+        "module": "dragonboat_tpu/core/kernel.py",
+        "function": "step_donated",
+        "donated": True,
+        "waiver": "",
+    },
+    "serve_step": {
+        "module": "dragonboat_tpu/parallel/ici.py",
+        "function": "jit_serve_step",
+        "donated": False,
+        "waiver": "depth-0 mesh oracle: the differential reference "
+                  "entry must leave its inputs readable",
+    },
+    "serve_step_donated": {
+        "module": "dragonboat_tpu/parallel/ici.py",
+        "function": "jit_serve_step_donated",
+        "donated": True,
+        "waiver": "",
+    },
+}
+
+
+class SerialDispatch:
+    """Single-device backend: inbox re-staged from host every step."""
+
+    def __init__(self, kp: KP.KernelParams,
+                 step_fn=None, donated_fn=None) -> None:
+        self.kp = kp
+        # per-instance telemetry wrappers (own counters): a first
+        # compile at THIS engine's geometry is never mistaken for a
+        # retrace of another engine sharing the jitted function.
+        # step_fn/donated_fn let the engine bind ITS module globals
+        # (chaos tests swap in mutated kernels there)
+        self.entries = {
+            "step": _capacity.TRACKER.wrap(
+                "step", step_fn if step_fn is not None else kernel_step),
+            "step_donated": _capacity.TRACKER.wrap(
+                "step_donated",
+                donated_fn if donated_fn is not None
+                else kernel_step_donated),
+        }
+
+    def dispatch(self, state, inbox, inp, donate: bool):
+        """One jitted step.  ``donate=True`` routes through the donating
+        entry (core/kernel.py ``step_donated``): XLA reuses the
+        state/inbox/input buffers, so after this call the host must not
+        read them again — step_all's retire-before-dispatch order
+        upholds that."""
+        entry = self.entries["step_donated" if donate else "step"]
+        return entry(self.kp, state, inbox.to_device(), inp.to_device())
+
+    def pending(self) -> bool:
+        """No device-resident inbox: nothing carries between steps."""
+        return False
+
+    def inbox_from(self, inbox_buf):
+        """[G, K] sender ids for the inbox-occupancy histogram — the
+        host-staged builder is the inbox here."""
+        return inbox_buf.from_
+
+    def shard(self, tree):
+        """Single device: placement is a no-op."""
+        return tree
+
+    def resident_trees(self) -> tuple:
+        return ()
+
+    def resident_classes(self) -> tuple:
+        return ()
+
+
+class MeshDispatch:
+    """shard_map backend over a ``Mesh(('g','r'))``: messages ride the
+    mesh inside the step (parallel/ici.py), the inbox is device-resident
+    between steps, and a partition mask cuts chaos-injected rows."""
+
+    def __init__(self, cluster: IciCluster) -> None:
+        self.cluster = cluster
+        total = cluster.total_rows
+        # device-resident inbox carried between steps (messages ride
+        # the mesh, not the host queues)
+        self.box = cluster.shard(empty_inbox(cluster.kp, total))
+        self._pending_msgs = 0
+        # device scalar from the LAST step, synced to the host lazily
+        # in pending(): an eager int() would block the step loop on the
+        # whole device step right at dispatch, defeating the pipelined
+        # overlap
+        self._pending_dev = None
+        # partition mask; device copy cached until the mask changes
+        self.cut = np.zeros((total,), bool)
+        self._cut_dev = None
+        self.entries = {
+            "serve_step": _capacity.TRACKER.wrap(
+                "serve_step", jit_serve_step),
+            "serve_step_donated": _capacity.TRACKER.wrap(
+                "serve_step_donated", jit_serve_step_donated),
+        }
+
+    def dispatch(self, state, inbox, inp, donate: bool):
+        """Advance the mesh: host-staged inputs, device-routed messages.
+        The host inbox builder is ignored — kernel-family traffic for
+        mesh shards never crosses the host (anything staged there is a
+        stray transport delivery and is dropped by design).
+        ``donate=True`` hands state, the carried inbox and the staged
+        input to XLA (kstate.DONATION ``serve_step_donated``); the
+        cached cut mask is never donated."""
+        cl = self.cluster
+        staged = cl.shard(inp.to_device())
+        if self._cut_dev is None:
+            self._cut_dev = cl.shard(jnp.asarray(self.cut))
+        entry = self.entries["serve_step_donated" if donate
+                             else "serve_step"]
+        state, box, out, pending = entry(
+            cl.kp, cl, state, self.box, staged, self._cut_dev)
+        self.box = box
+        # keep the pending count device-side; the next pending() call
+        # syncs it (after staging has already overlapped the step)
+        self._pending_dev = pending
+        return state, out
+
+    def pending(self) -> bool:
+        p = self._pending_dev
+        if p is not None:
+            self._pending_dev = None
+            self._pending_msgs = int(p)
+        return self._pending_msgs > 0
+
+    def inbox_from(self, inbox_buf):
+        # the mesh inbox is device-resident between steps; no host copy
+        return self.box.from_
+
+    def shard(self, tree):
+        """Place a [G]-leading pytree onto the mesh (digests and the
+        like shard along G exactly like the state they derive from)."""
+        return self.cluster.shard(tree)
+
+    def set_cut(self, lane: int, cut: bool) -> None:
+        """Flip one row's partition mask and invalidate the cached
+        device copy (next dispatch re-stages it)."""
+        self.cut[lane] = cut
+        self._cut_dev = None
+
+    def resident_trees(self) -> tuple:
+        # the carried inbox is device-resident between steps here
+        return (self.box,)
+
+    def resident_classes(self) -> tuple:
+        return ("Inbox",)

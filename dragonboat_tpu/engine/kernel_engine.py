@@ -465,6 +465,10 @@ class KernelEngine:
 
         self.capacity_watermark_pct = float(capacity_watermark_pct)
         self.capacity_budget_bytes = max(0, int(capacity_budget_bytes))
+        # the ONE dispatch backend (engine/dispatch.py): subclasses pick
+        # a backend through the _make_dispatch seam instead of overriding
+        # step-loop internals — the engine-unity lint pass enforces it
+        self._dispatch = self._make_dispatch()
         self._cap_entries = self._capacity_entries()
         self.last_capacity: dict | None = None
         self._capacity_seq = 0          # capacity ticks (flight stamp)
@@ -929,15 +933,27 @@ class KernelEngine:
         ctx.staged_ri.pop(n.lane, None)
         ctx.dead.add(n.lane)
 
+    def _make_dispatch(self):
+        """Dispatch-backend factory — the sanctioned seam an engine
+        subclass uses to change WHERE the step runs (serial jit vs the
+        parallel/ici.py shard_map path) without growing a second step
+        loop.  Called once at the end of __init__."""
+        from dragonboat_tpu.engine.dispatch import SerialDispatch
+
+        # bind THIS module's globals at construction: chaos tests swap
+        # kernel_step/kernel_step_donated for mutated kernels here
+        return SerialDispatch(self.kp, kernel_step, kernel_step_donated)
+
     def _device_pending(self) -> bool:
-        """Mesh engines carry a device-resident inbox between steps; the
-        single-device engine rebuilds its inbox from host queues."""
-        return False
+        """True while the dispatch backend carries undelivered messages
+        between steps (the mesh backend's device-resident inbox); the
+        serial backend re-stages from host queues and never does."""
+        return self._dispatch.pending()
 
     def _fleet_inbox_from(self):
-        """[G, K] sender ids feeding the inbox-occupancy histogram; the
-        single-device engine's inbox is host-staged each step."""
-        return self._inbox_buf.from_
+        """[G, K] sender ids feeding the inbox-occupancy histogram: the
+        backend picks the host-staged builder or its carried box."""
+        return self._dispatch.inbox_from(self._inbox_buf)
 
     def _collect_fleet_stats(self) -> None:
         """Decimated fleet telemetry: one jitted reduction over the
@@ -951,11 +967,12 @@ class KernelEngine:
         self.last_fleet = _fleet.stats_to_dict(stats)
 
     def _make_health_digest(self):
-        """Fresh all-zero digest matching the engine's lane geometry;
-        the mesh override shards it along G."""
+        """Fresh all-zero digest matching the engine's lane geometry,
+        placed by the dispatch backend (the mesh backend shards it
+        along G like the state it derives from)."""
         from dragonboat_tpu.core import health as _health
 
-        return _health.empty_digest(self.capacity)
+        return self._dispatch.shard(_health.empty_digest(self.capacity))
 
     def _collect_health(self) -> None:
         """Decimated anomaly classification (core/health.py), on the
@@ -989,10 +1006,12 @@ class KernelEngine:
 
     def _make_invariant_digest(self):
         """Fresh all-zero invariant digest matching the engine's lane
-        geometry; the mesh override shards it along G."""
+        geometry, placed by the dispatch backend (same sharding story
+        as the health digest)."""
         from dragonboat_tpu.core import invariants as _invariants
 
-        return _invariants.empty_digest(self.capacity)
+        return self._dispatch.shard(
+            _invariants.empty_digest(self.capacity))
 
     def _collect_invariants(self) -> None:
         """Decimated protocol-invariant probe (core/invariants.py), on
@@ -1033,36 +1052,40 @@ class KernelEngine:
 
     def _capacity_entries(self) -> dict:
         """Compile-telemetry wrappers for every jit entry this engine
-        dispatches.  Each engine wraps independently (own counters): a
-        first compile at THIS engine's geometry is never mistaken for a
-        retrace of another engine sharing the same jitted function."""
+        dispatches: the backend's step entries (serial step/step_donated
+        or the mesh serve pair) plus the shared telemetry reductions.
+        Each engine wraps independently (own counters): a first compile
+        at THIS engine's geometry is never mistaken for a retrace of
+        another engine sharing the same jitted function."""
         from dragonboat_tpu import capacity as _capacity
         from dragonboat_tpu.core import fleet as _fleet
         from dragonboat_tpu.core import health as _health
         from dragonboat_tpu.core import invariants as _invariants
 
-        return {
-            "step": _capacity.TRACKER.wrap("step", kernel_step),
-            "step_donated": _capacity.TRACKER.wrap(
-                "step_donated", kernel_step_donated),
+        entries = dict(self._dispatch.entries)
+        entries.update({
             "fleet_stats": _capacity.TRACKER.wrap(
                 "fleet_stats", _fleet.fleet_stats),
             "fleet_health": _capacity.TRACKER.wrap(
                 "fleet_health", _health.fleet_health),
             "check_invariants": _capacity.TRACKER.wrap(
                 "check_invariants", _invariants.check_invariants),
-        }
+        })
+        return entries
 
     def _capacity_trees(self) -> tuple:
         """Device-resident trees this engine keeps alive between steps
-        (the mesh override adds its carried inbox)."""
-        return (self.state, self._health_digest, self._inv_digest)
+        (the mesh backend adds its carried inbox)."""
+        return (self.state, self._health_digest, self._inv_digest) \
+            + self._dispatch.resident_trees()
 
     def _capacity_model_classes(self) -> tuple:
         """Contract classes resident on device for this engine's
-        geometry: the single-device engine re-stages its inbox from host
-        each step, so only state + digests persist."""
-        return ("ShardState", "HealthDigest", "InvariantDigest")
+        geometry: the serial backend re-stages its inbox from host each
+        step, so only state + digests persist; the mesh backend carries
+        its Inbox."""
+        return ("ShardState", "HealthDigest", "InvariantDigest") \
+            + self._dispatch.resident_classes()
 
     def _collect_capacity(self) -> None:
         """Decimated capacity accounting, riding the fleet cadence under
@@ -1110,16 +1133,13 @@ class KernelEngine:
         return _health.row_to_dict(row)
 
     def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
-        if self.pipeline_depth > 0:
-            # donating entry (core/kernel.py step_donated): XLA reuses
-            # the state/inbox/input buffers in place of per-step fresh
-            # allocations.  After this call the host must not read the
-            # passed-in state again — step_all's retire-before-dispatch
-            # order upholds that
-            return self._cap_entries["step_donated"](
-                self.kp, self.state, inbox.to_device(), inp.to_device())
-        return self._cap_entries["step"](
-            self.kp, self.state, inbox.to_device(), inp.to_device())
+        # depth > 0 routes through the backend's donating entry: XLA
+        # reuses the state/inbox/input buffers in place of per-step
+        # fresh allocations.  After a donating dispatch the host must
+        # not read the passed-in state again — step_all's
+        # retire-before-dispatch order upholds that on BOTH backends
+        return self._dispatch.dispatch(
+            self.state, inbox, inp, donate=self.pipeline_depth > 0)
 
     # -- staging ----------------------------------------------------------
 

@@ -41,7 +41,7 @@ from jax.sharding import Mesh
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.config import MeshSpec
 from dragonboat_tpu.core import params as KP
-from dragonboat_tpu.core.kstate import empty_inbox, init_state
+from dragonboat_tpu.core.kstate import init_state
 from dragonboat_tpu.engine.kernel_engine import (
     KernelEngine,
     KernelNode,
@@ -103,18 +103,6 @@ class MeshEngine(KernelEngine):
         self.state = self.cluster.shard(init_state(
             kp, total, replica_id=rids,
             peer_ids=np.zeros((total, kp.num_peers), np.int32)))
-        # device-resident inbox carried between steps (messages ride the
-        # mesh, not the host queues)
-        self.box = self.cluster.shard(empty_inbox(kp, total))
-        self._pending_msgs = 0
-        # device scalar from the LAST step, synced to the host lazily in
-        # _device_pending: the eager int() forced the step loop to block
-        # on the whole device step right at dispatch, defeating the
-        # pipelined overlap
-        self._pending_dev = None
-        # partition mask; device copy cached until the mask changes
-        self._cut = np.zeros((total,), bool)
-        self._cut_dev = None
         # group-lane bookkeeping
         self._lane_of: dict[int, int] = {}            # shard_id -> lane
         # newest membership ccid written to each group's shared peer
@@ -188,8 +176,7 @@ class MeshEngine(KernelEngine):
             self.nodes.pop(node.lane, None)
             self._removed_nodes.append(node)
             self._clear_lane(node.lane)
-            self._cut[node.lane] = False
-            self._cut_dev = None
+            self._dispatch.set_cut(node.lane, False)
             if not members:
                 lane = self._lane_of.pop(node.shard_id, None)
                 self._members.pop(node.shard_id, None)
@@ -219,70 +206,19 @@ class MeshEngine(KernelEngine):
         """Device-side partition mask for one replica row."""
         with self.mu:
             if self._is_registered(node):
-                self._cut[node.lane] = cut
-                self._cut_dev = None
+                self._dispatch.set_cut(node.lane, cut)
 
     # -- the step ----------------------------------------------------------
 
-    def _device_pending(self) -> bool:
-        p = self._pending_dev
-        if p is not None:
-            self._pending_dev = None
-            self._pending_msgs = int(p)
-        return self._pending_msgs > 0
+    def _make_dispatch(self):
+        """The mesh backend (engine/dispatch.py MeshDispatch): donated +
+        depth-1-pipelined shard_map dispatch through parallel/ici.py,
+        with the carried inbox, pending counter and partition mask owned
+        by the backend.  The step loop itself stays KernelEngine's —
+        this seam is the ONLY dispatch-level difference."""
+        from dragonboat_tpu.engine.dispatch import MeshDispatch
 
-    def _fleet_inbox_from(self):
-        # the mesh inbox is device-resident between steps; no host copy
-        return self.box.from_
-
-    def _make_health_digest(self):
-        # the digest is per-row device state (part=G): shard it along
-        # the mesh like the ShardState/Inbox it is derived from
-        from dragonboat_tpu.core import health as _health
-
-        return self.cluster.shard(_health.empty_digest(self.capacity))
-
-    def _make_invariant_digest(self):
-        # same sharding story as the health digest: per-row part=G
-        from dragonboat_tpu.core import invariants as _invariants
-
-        return self.cluster.shard(
-            _invariants.empty_digest(self.capacity))
-
-    def _capacity_entries(self) -> dict:
-        # the mesh dispatches through the jitted serve-step (the base
-        # step/step_donated wrappers stay registered but see no calls)
-        from dragonboat_tpu import capacity as _capacity
-        from dragonboat_tpu.parallel import ici as _ici
-
-        entries = super()._capacity_entries()
-        entries["ici_serve_step"] = _capacity.TRACKER.wrap(
-            "ici_serve_step", _ici._jit_serve_step)
-        return entries
-
-    def _capacity_trees(self) -> tuple:
-        # the carried inbox is device-resident between steps here
-        return super()._capacity_trees() + (self.box,)
-
-    def _capacity_model_classes(self) -> tuple:
-        return super()._capacity_model_classes() + ("Inbox",)
-
-    def _kernel_call(self, inbox, inp):
-        """Advance the mesh: host-staged inputs, device-routed messages.
-        The host inbox builder is ignored — kernel-family traffic for
-        mesh shards never crosses the host (anything staged there is a
-        stray transport delivery and is dropped by design)."""
-        cl = self.cluster
-        staged = cl.shard(inp.to_device())
-        if self._cut_dev is None:
-            self._cut_dev = cl.shard(jax.numpy.asarray(self._cut))
-        state, box, out, pending = self._cap_entries["ici_serve_step"](
-            cl.kp, cl, self.state, self.box, staged, self._cut_dev)
-        self.box = box
-        # keep the pending count device-side; the next _device_pending
-        # call syncs it (after staging has already overlapped the step)
-        self._pending_dev = pending
-        return state, out
+        return MeshDispatch(self.cluster)
 
     def _emit_messages(self, g, n, o, fl, pid, kind,
                        replicates, others) -> None:
@@ -300,7 +236,7 @@ class MeshEngine(KernelEngine):
         valid entry point, like the reference's MsgProp forwarding). Falls
         back to the proposer's own row when no leader is known — the
         kernel then drops and the client retries."""
-        if self._cut[n.lane]:
+        if self._dispatch.cut[n.lane]:
             # a partitioned host's proposals must not tunnel through
             # shared memory to the leader row — stage on the cut row,
             # where the kernel drops them (the client sees DROPPED, as it
@@ -309,7 +245,7 @@ class MeshEngine(KernelEngine):
         lid = n._leader_cache
         if lid and lid != n.replica_id:
             leader = self._members.get(n.shard_id, {}).get(lid)
-            if leader is not None and not self._cut[leader.lane]:
+            if leader is not None and not self._dispatch.cut[leader.lane]:
                 return leader.lane, leader
         return n.lane, n
 

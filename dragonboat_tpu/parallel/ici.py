@@ -217,7 +217,26 @@ def _serve_body(kp: KP.KernelParams, replicas: int,
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
-def _jit_serve_step(kp, cluster: IciCluster, state, box, inp, cut):
+def jit_serve_step(kp, cluster: IciCluster, state, box, inp, cut):
+    """Jitted serving entry (non-donated): the depth-0 mesh oracle the
+    engine dispatch layer wraps in compile telemetry."""
+    body = shard_map(
+        functools.partial(_serve_body, kp, cluster.replicas),
+        mesh=cluster.mesh,
+        in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")),
+                  PS(("g", "r"))),
+        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")), PS()),
+    )
+    return body(state, box, inp, cut)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
+def jit_serve_step_donated(kp, cluster: IciCluster, state, box, inp, cut):
+    """Donating twin of ``jit_serve_step`` for the pipelined dispatch:
+    state, the carried inbox and the staged input hand their buffers to
+    XLA (kstate.DONATION ``serve_step_donated``; host no-touch rule
+    applies after dispatch).  ``cut`` is NOT donated — the engine caches
+    the device copy of the partition mask across steps."""
     body = shard_map(
         functools.partial(_serve_body, kp, cluster.replicas),
         mesh=cluster.mesh,
@@ -234,7 +253,7 @@ def ici_serve_step(cluster: IciCluster, state: ShardState, box: Inbox,
 
     The mesh-engine equivalent of router.cluster_step — the transport
     seam (transport.go:86-101) is the all_gather inside the body."""
-    return _jit_serve_step(cluster.kp, cluster, state, box, inp, cut)
+    return jit_serve_step(cluster.kp, cluster, state, box, inp, cut)
 
 
 def self_driving_input(kp: KP.KernelParams, state: ShardState,

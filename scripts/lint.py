@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis runner: the seven lint passes over the repo.
+"""Static-analysis runner: the eight lint passes over the repo.
 
 Passes (dragonboat_tpu/analysis/):
 
@@ -24,6 +24,15 @@ Passes (dragonboat_tpu/analysis/):
                   bodies, implicit device→host syncs in the engine hot
                   paths, and a 2-device dynamic diff of declared vs
                   actual output shardings
+  engine-unity    one step loop, one dispatch abstraction: subclass
+                  step-loop overrides (EU001), per-path dispatch
+                  feature drift (EU002), donation/waiver parity of the
+                  declared dispatch entries (EU003), the pipelined
+                  retire-before-dispatch protocol on every path
+                  (EU004), CompileTracker coverage of every jit entry
+                  the engine layer touches (EU005), and engine-layer
+                  imports of kernel internals (EU006) — all against
+                  the literal contract in engine/dispatch.py
   safety          Raft protocol safety: the kstate INVARIANTS
                   declarations lint (RS001/RS006), provenance-checked
                   store obligations on committed / vote / last in
@@ -87,6 +96,7 @@ from dragonboat_tpu.analysis import (  # noqa: E402
     concurrency,
     contracts,
     determinism,
+    engine_unity,
     hlo_budget,
     partition,
     safety,
@@ -100,6 +110,7 @@ PASSES = {
     "hlo-budget": hlo_budget.run,
     "contracts": contracts.run,
     "partition": partition.run,
+    "engine-unity": engine_unity.run,
     "safety": safety.run,
 }
 
@@ -110,8 +121,10 @@ PASS_SCOPES = {
     "concurrency": concurrency.DEFAULT_MODULES,
     "determinism": determinism.DEFAULT_GLOBS,
     "hlo-budget": hlo_budget.CACHE_SOURCES,
-    "contracts": contracts.CONTRACT_FILES + (contracts.PARAMS_FILE,),
+    "contracts": (contracts.CONTRACT_FILES + (contracts.PARAMS_FILE,)
+                  + contracts.DONATION_MODULES),
     "partition": partition.SCOPE,
+    "engine-unity": engine_unity.SCOPE,
     "safety": safety.SCOPE,
 }
 

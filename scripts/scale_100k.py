@@ -94,15 +94,21 @@ def phase_a() -> None:
     box_bytes = capacity.measure_tree_bytes(box)
     budget = _budget_bytes(capacity)
     max_g = capacity.max_g_for_budget(kp, budget, classes)
-    # iters is a static jit arg: warm the EXACT executable we measure
+    # iters is a static jit arg: warm the EXACT executable we measure —
+    # through CompileTracker, so the rung itself proves the steady-state
+    # contract (one compile at this geometry, zero retraces after)
+    tracked = capacity.TRACKER.wrap("scale_run_steps", run_steps)
     t0 = time.time()
-    state, box = run_steps(kp, 3, STEPS, True, True, state, box)
+    state, box = tracked(kp, 3, STEPS, True, True, state, box)
     jax.block_until_ready(state.term)
     compile_s = time.time() - t0
     t0 = time.time()
-    state, box = run_steps(kp, 3, STEPS, True, True, state, box)
+    state, box = tracked(kp, 3, STEPS, True, True, state, box)
     jax.block_until_ready(state.term)
     dt = time.time() - t0
+    tstats = tracked.stats()
+    assert tstats["compiles"] == 1 and tstats["retraces"] == 0, (
+        f"scale rung retraced: {tstats}")
     print("PHASE_A " + json.dumps({
         "groups": GROUPS, "lanes": GROUPS * 3,
         "platform": jax.devices()[0].platform,
@@ -114,6 +120,8 @@ def phase_a() -> None:
         "max_g_at_budget": max_g,
         "compile_s": round(compile_s, 1),
         "step_ms": round(dt / STEPS * 1e3, 1),
+        "dispatch_compiles": tstats["compiles"],
+        "dispatch_retraces": tstats["retraces"],
         "rss_gb": round(rss_gb(), 2),
     }), flush=True)
     del state, box
@@ -228,6 +236,13 @@ def phase_b() -> None:
     eng.step_all()
     wave_steps_s = time.time() - stage_t0
     committed = int(np.asarray(eng.state.committed)[:n_shards].sum())
+    # the rung ran entirely through the unified dispatch seam
+    # (engine/dispatch.py): its active tracked entry must show exactly
+    # one compile at this capacity and zero steady-state retraces
+    active = "step_donated" if eng.pipeline_depth > 0 else "step"
+    dstats = eng._cap_entries[active].stats()
+    assert dstats["compiles"] == 1 and dstats["retraces"] == 0, (
+        f"dispatch entry {active!r} retraced at scale: {dstats}")
     # same model the engine's /debug/capacity serves: classes + trees
     # come from the engine so the rung and the endpoint can't diverge
     from dragonboat_tpu import capacity
@@ -254,6 +269,9 @@ def phase_b() -> None:
         "proposals_queued": waves,
         "wave_2steps_s": round(wave_steps_s, 3),
         "committed_total": committed,
+        "dispatch_entry": active,
+        "dispatch_compiles": dstats["compiles"],
+        "dispatch_retraces": dstats["retraces"],
     }), flush=True)
     nh.close()
 

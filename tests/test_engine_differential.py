@@ -2,12 +2,18 @@
 paths are the same state machine, bit for bit, under a randomized
 schedule.
 
-KernelEngine._kernel_call drives the router-layout kernel
-(core/router.cluster_step: step + host-shaped routing); MeshEngine
-._kernel_call drives ici_serve_step (parallel/ici.py: step + device
-psum routing under shard_map on a (g, r) mesh).  Everything above that
+Both engines run KernelEngine.step_all over the unified dispatch seam
+(engine/dispatch.py): the serial backend drives the router-layout
+kernel (core/router.cluster_step: step + host-shaped routing), the
+mesh backend drives the shard_map serving entry (parallel/ici.py:
+step + device psum routing on a (g, r) mesh).  Everything above that
 seam — staging, retirement, node bookkeeping — is shared KernelEngine
-code, so this is the exact point where the two engines can diverge.
+code, so the backends' jit entries are the exact point where the two
+engines can diverge — and each backend exposes a donated + non-donated
+entry pair, so BOTH depths need pinning: the depth-0 arm drives the
+non-donated oracles, the depth-1 arm the donated entries under the
+engine's retire-before-dispatch protocol (step N-1's state is pulled
+to the host before step N's dispatch hands the buffers to XLA).
 
 tests/test_mesh_differential.py pins the seam under the deterministic
 self-driving schedule.  This file pins it under an ADVERSARIAL one: 300
@@ -29,9 +35,10 @@ import pytest
 from jax.sharding import Mesh
 
 from dragonboat_tpu.core import params as KP
-from dragonboat_tpu.core.router import cluster_step
+from dragonboat_tpu.core.router import cluster_step, cluster_step_donated
 from dragonboat_tpu.parallel.ici import (
     ici_serve_step,
+    jit_serve_step_donated,
     make_ici_cluster,
 )
 from dragonboat_tpu.core.kstate import StepInput
@@ -73,7 +80,11 @@ def _perm(g_size: int, replicas: int, n_local: int) -> np.ndarray:
 
 
 def _pull(tree):
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    # np.array, not np.asarray: on CPU np.asarray of a jax array is a
+    # ZERO-COPY view of the device buffer, and the depth-1 arm donates
+    # those buffers right after retiring them — a view would be read
+    # after XLA reclaimed the storage (observed as a segfault)
+    return jax.tree.map(lambda x: np.array(x), tree)
 
 
 def _permute(tree, perm):
@@ -157,3 +168,68 @@ def test_engine_kernel_paths_bitwise_equal(seed):
             f"seed {seed} step {step_no}: pending diverged")
         committed = int(np.asarray(state_r.committed).max())
     assert committed > 0, "randomized differential ran but never committed"
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_engine_kernel_paths_bitwise_equal_depth1(seed):
+    """The donated depth-1 arm: 300 randomized micro-steps through BOTH
+    engines' pipelined dispatch entries (core/router.cluster_step_donated
+    vs parallel/ici.py jit_serve_step_donated), bitwise-identical.
+
+    Mirrors the engine's retire-before-dispatch protocol: step N-1's
+    state/box are pulled to the host (retired) BEFORE step N's dispatch
+    donates the device buffers to XLA, inputs are built from the retired
+    copies, and the mesh's device-side pending scalar is consumed one
+    step late — exactly how KernelEngine.step_all at pipeline_depth=1
+    consumes MeshDispatch's deferred count."""
+    kp = _kp(REPLICAS)
+    mesh = _mesh(G_SIZE, REPLICAS)
+    cluster, state_m, box_m = make_ici_cluster(
+        kp, mesh, num_groups=G_SIZE * N_LOCAL)
+    perm = _perm(G_SIZE, REPLICAS, N_LOCAL)
+    iperm = np.argsort(perm)
+    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+
+    state_r = _permute(_pull(state_m), perm)
+    box_r = _permute(_pull(box_m), perm)
+
+    rng = np.random.default_rng(seed)
+    committed = 0
+    pending_dev = None
+    for step_no in range(STEPS):
+        # retire step N-1: pull BEFORE dispatch — after the donating
+        # call the old device buffers belong to XLA
+        st_m_mesh = _pull(state_m)
+        st_m = _permute(st_m_mesh, perm)
+        bx_m = _permute(_pull(box_m), perm)
+        st_r = _pull(state_r)
+        bx_r = _pull(box_r)
+        _assert_equal(f"seed {seed} step {step_no} state (depth1)",
+                      st_m, st_r)
+        _assert_equal(f"seed {seed} step {step_no} box (depth1)",
+                      bx_m, bx_r)
+        if pending_dev is not None:
+            # the deferred device scalar from step N-1's dispatch must
+            # equal the router inbox occupancy after step N-1
+            assert int(pending_dev) == int((bx_r.mtype != 0).sum()), (
+                f"seed {seed} step {step_no}: pending diverged (depth1)")
+        committed = int(st_r.committed.max())
+
+        draws = rng.bit_generator.state
+        inp_r = _random_input(kp, rng, st_r, None)
+        rng.bit_generator.state = draws
+        inp_m = _random_input(kp, rng, st_m_mesh, iperm)
+
+        state_m, box_m, _, pending_dev = jit_serve_step_donated(
+            kp, cluster, state_m, box_m, cluster.shard(inp_m), cut)
+        state_r, box_r, _ = cluster_step_donated(
+            kp, REPLICAS, state_r, box_r, inp_r)
+
+    # final retire: the last dispatched step must still agree
+    _assert_equal(f"seed {seed} final state (depth1)",
+                  _permute(_pull(state_m), perm), _pull(state_r))
+    _assert_equal(f"seed {seed} final box (depth1)",
+                  _permute(_pull(box_m), perm), _pull(box_r))
+    assert int(pending_dev) == int(
+        (np.asarray(box_r.mtype) != 0).sum()), "final pending diverged"
+    assert committed > 0, "depth-1 differential ran but never committed"
