@@ -60,7 +60,10 @@ wrappers + tree-bytes walk + snapshot assembly — on top of the
 stats+health path — see run_capacity_ab), BENCH_SAFETY=1 (standalone
 mode: interleaved A-B overhead of the runtime invariant probe —
 check_invariants + digest carry + O(NI) report fetch — on top of the
-stats+health path — see run_safety_ab).
+stats+health path — see run_safety_ab), BENCH_TRANSFER=1 (standalone
+mode: interleaved A-B overhead of the transfer-guard rail —
+capacity.METER tag counters + scoped jax.transfer_guard around the
+dispatch seam — see run_transfer_ab).
 """
 
 import json
@@ -1131,6 +1134,100 @@ def run_health_ab() -> None:
     })
 
 
+def run_transfer_ab() -> None:
+    """BENCH_TRANSFER=1: interleaved A-B overhead of the transfer-guard
+    rail (capacity.METER + jax.transfer_guard) on the engine dispatch
+    seam.
+
+    Arm A drives SerialDispatch + the staging builders + the per-step
+    flags fetch bare; arm B runs the identical loop inside
+    ``METER.guard()`` — every declared crossing then enters a scoped
+    ``transfer_guard("allow")`` and bumps its tag counter, which is
+    exactly what the transfer lint pass's dynamic leg and the guarded
+    differential tests add on top of production.  Arms interleave
+    A,B,A,B,... (median-of-3 per arm) so cluster drift lands on both.
+    The detail block carries the static per-step ledger bytes at this
+    geometry plus the observed METER tag counts, tying the measured
+    loop to the transfer_ledger crossing inventory.  Knobs:
+    BENCH_TRANSFER_GROUPS (default 2048), BENCH_TRANSFER_STEPS (200).
+    Expected: noise floor — the rail is a dict bump and a context
+    manager per crossing."""
+    import contextlib
+
+    import jax
+    import numpy as np
+
+    from dragonboat_tpu import capacity
+    from dragonboat_tpu.analysis import transfer as transfer_pass
+    from dragonboat_tpu.bench_loop import bench_params, make_cluster
+    from dragonboat_tpu.core.kernel import output_row_flags
+    from dragonboat_tpu.engine import kernel_engine as _ke
+    from dragonboat_tpu.engine.dispatch import SerialDispatch
+
+    platform = jax.devices()[0].platform
+    replicas = 3
+    g = int(os.environ.get("BENCH_TRANSFER_GROUPS", "2048"))
+    steps = int(os.environ.get("BENCH_TRANSFER_STEPS", "200"))
+    kp = bench_params(replicas)
+    state = make_cluster(kp, g, replicas)
+    lanes = int(state.term.shape[0])
+    disp = SerialDispatch(kp)
+    inbox = _ke._InboxBuilder(lanes, kp.inbox_cap, kp.msg_entries)
+    inp = _ke._InputBuilder(lanes, kp.proposal_cap)
+
+    def window(guarded: bool) -> float:
+        nonlocal state
+        ctx = (capacity.METER.guard() if guarded
+               else contextlib.nullcontext())
+        t0 = time.time()
+        with ctx:
+            for _ in range(steps):
+                state, out = disp.dispatch(state, inbox, inp,
+                                           donate=False)
+                with capacity.METER.sanctioned("output_flags"):
+                    np.asarray(output_row_flags(out))
+        state.term.block_until_ready()
+        return time.time() - t0
+
+    window(True)  # warm every compile and the guard path itself
+    capacity.METER.reset()
+    a_walls, b_walls = [], []
+    for _ in range(3):
+        a_walls.append(window(False))
+        b_walls.append(window(True))
+    a = sorted(a_walls)[1]
+    b = sorted(b_walls)[1]
+    overhead_pct = (b - a) / a * 100.0
+    cfg = dict(transfer_pass.DEFAULT_CONFIG)
+    cfg.update(num_groups=lanes, num_peers=kp.num_peers,
+               log_cap=kp.log_cap, inbox_cap=kp.inbox_cap,
+               msg_entries=kp.msg_entries, proposal_cap=kp.proposal_cap,
+               readindex_cap=kp.readindex_cap,
+               inline_payloads=bool(kp.inline_payloads))
+    ledger = transfer_pass.build_ledger(
+        os.path.dirname(os.path.abspath(__file__)), cfg=cfg)
+    emit({
+        "metric": (f"transfer-guard rail step-latency overhead, "
+                   f"{g} groups x {replicas} replicas"),
+        "value": round(overhead_pct, 2),
+        "unit": "% vs unguarded dispatch loop",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "groups": g,
+            "replicas": replicas,
+            "steps_per_arm_window": steps,
+            "plain_wall_s": [round(x, 3) for x in a_walls],
+            "guarded_wall_s": [round(x, 3) for x in b_walls],
+            "plain_step_ms": round(a / steps * 1e3, 3),
+            "guarded_step_ms": round(b / steps * 1e3, 3),
+            "meter_counts_all_windows": capacity.METER.counts(),
+            "ledger_per_step_serial": ledger["per_step"]["serial"],
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def run_safety_ab() -> None:
     """BENCH_SAFETY=1: interleaved A-B overhead of the runtime
     invariant probe (core/invariants.py) on top of the fleet_stats +
@@ -1847,6 +1944,14 @@ def main() -> None:
             import traceback
 
             fail("mesh-pipeline-ab", traceback.format_exc())
+        return
+    if os.environ.get("BENCH_TRANSFER") == "1":
+        try:
+            run_transfer_ab()
+        except Exception:
+            import traceback
+
+            fail("transfer-ab", traceback.format_exc())
         return
     if os.environ.get("BENCH_SAFETY") == "1":
         try:

@@ -152,6 +152,35 @@ def max_g_for_budget(kp, budget_bytes: int,
     return int(budget_bytes) // per_group
 
 
+def bytes_for_contract(spec: str, kp, num_groups: int,
+                       axis_extra: dict | None = None) -> int:
+    """Closed-form bytes of one value declared as a contract string
+    (``"[G, K] i32"``).  ``G`` resolves to ``num_groups``, symbolic axes
+    through AXIS_PARAMS (kernel geometry) or ``axis_extra`` (host-side
+    constants like histogram widths), decimal literals to themselves,
+    and an empty axis list (``"[] i32"``) to a scalar.  Unlike
+    ``model_bytes_per_group`` this sizes boundary crossings, which are
+    not always per-group — hence no leading-G requirement."""
+    from dragonboat_tpu.analysis.common import parse_contract
+
+    fc = parse_contract(spec, "transfer")
+    n = DTYPE_BYTES[fc.dtype]
+    for ax in fc.axes:
+        if ax == "G":
+            n *= int(num_groups)
+        elif ax.isdigit():
+            n *= int(ax)
+        elif ax in AXIS_PARAMS:
+            n *= int(getattr(kp, AXIS_PARAMS[ax]))
+        elif axis_extra and ax in axis_extra:
+            n *= int(axis_extra[ax])
+        else:
+            raise ValueError(
+                f"transfer model: axis {ax!r} in {spec!r} has no extent "
+                "(KernelParams AXIS_PARAMS or axis_extra)")
+    return n
+
+
 # ---------------------------------------------------------------------------
 # device-memory accounting
 # ---------------------------------------------------------------------------
@@ -388,6 +417,104 @@ TRACKER = CompileTracker()
 #: core transition kinds; re-exported here for callers of this module)
 RETRACE_STORM = _flight.RETRACE_STORM
 MEMORY_PRESSURE = _flight.MEMORY_PRESSURE
+
+
+# ---------------------------------------------------------------------------
+# transfer metering (host<->device boundary crossings)
+# ---------------------------------------------------------------------------
+
+
+class _SanctionedCrossing:
+    """One declared boundary crossing: counts its tag, and — only while
+    a disallow guard is active — re-allows transfers for its extent so
+    everything OUTSIDE a sanctioned scope keeps raising."""
+
+    __slots__ = ("_meter", "_tag", "_cm")
+
+    def __init__(self, meter: "TransferMeter", tag: str) -> None:
+        self._meter = meter
+        self._tag = tag
+        self._cm = None
+
+    def __enter__(self) -> "_SanctionedCrossing":
+        m = self._meter
+        with m.mu:
+            m._counts[self._tag] = m._counts.get(self._tag, 0) + 1
+            guarding = m._guard_depth > 0
+        if guarding:
+            self._cm = jax.transfer_guard("allow")
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        cm, self._cm = self._cm, None
+        if cm is not None:
+            return bool(cm.__exit__(*exc))
+        return False
+
+
+class _TransferGuard:
+    """``jax.transfer_guard("disallow")`` plus the meter's guard-depth
+    bookkeeping (sanctioned scopes only pay the allow-context cost when
+    a guard is actually active — unguarded runs stay at a dict bump)."""
+
+    __slots__ = ("_meter", "_cm")
+
+    def __init__(self, meter: "TransferMeter") -> None:
+        self._meter = meter
+        self._cm = None
+
+    def __enter__(self) -> "_TransferGuard":
+        m = self._meter
+        with m.mu:
+            m._guard_depth += 1
+        self._cm = jax.transfer_guard("disallow")
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        m = self._meter
+        with m.mu:
+            m._guard_depth = max(0, m._guard_depth - 1)
+        cm, self._cm = self._cm, None
+        return bool(cm.__exit__(*exc)) if cm is not None else False
+
+
+class TransferMeter:
+    """Live host<->device crossing counter behind the transfer-boundary
+    contract (analysis/transfer.py).  Every declared crossing site in
+    the engine layer wraps its transfer in ``sanctioned(tag)``; the
+    transfer lint's dynamic leg and the engine differentials run the
+    step loop under ``guard()`` and diff ``counts()`` against the
+    static TRANSFER_LEDGER — an unsanctioned implicit transfer raises,
+    a sanctioned one is tallied under its declared tag."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()
+        self._counts: dict = {}    # guarded-by: mu  (tag -> crossings)
+        self._guard_depth = 0      # guarded-by: mu
+
+    def sanctioned(self, tag: str) -> _SanctionedCrossing:
+        """Context manager for one declared crossing (see class doc)."""
+        return _SanctionedCrossing(self, tag)
+
+    def guard(self) -> _TransferGuard:
+        """Disallow-implicit-transfers context for tests and lint."""
+        return _TransferGuard(self)
+
+    def counts(self) -> dict:
+        with self.mu:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self.mu:
+            self._counts.clear()
+
+
+#: process-wide meter (one-instance doctrine, like TRACKER): the engine
+#: layer's sanctioned scopes and the transfer lint's differential read
+#: the same tallies
+METER = TransferMeter()
 
 
 # ---------------------------------------------------------------------------

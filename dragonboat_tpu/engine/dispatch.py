@@ -129,6 +129,198 @@ DISPATCH_ENTRIES = {
     },
 }
 
+#: every sanctioned device->host SYNC site in the engine layer, keyed by
+#: the host-side qualname whose body may force a device value
+#: (``int()`` / ``.item()`` / ``np.asarray`` / ``block_until_ready``).
+#: The transfer pass (analysis/transfer.py TB005 — the engine-scope
+#: sharpening of PS006) fails any other engine-layer sync; the runtime
+#: leg counts each under ``tag`` via capacity.METER.  Declaring a site
+#: here is a REVIEWED claim that the sync is off the per-step critical
+#: path or deliberately masked/lazy.
+SYNC_POINTS = {
+    "MeshDispatch.pending": {
+        "tag": "mesh_pending",
+        "why": "lazy int() of the carried pending-count scalar, one "
+               "step after dispatch so staging overlaps the device step",
+    },
+    "_LazyOut.__getitem__": {
+        "tag": "lazy_out",
+        "why": "memoized per-field StepOutput fetch — the masked-fetch "
+               "path that replaced the eager 42-field sweep",
+    },
+    "KernelEngine._process_outputs": {
+        "tag": "output_flags",
+        "why": "the [G, 8] activity matrix gating the masked fetch, "
+               "plus the save-window lt rows for persisted lanes",
+    },
+    "KernelEngine._emit_messages": {
+        "tag": "wit_snap_floor",
+        "why": "witness-snapshot floor probe (snap_index scalar) on the "
+               "rare wit_snap retire path only",
+    },
+}
+
+#: the machine-read transfer contract: every value crossing the
+#: device<->host boundary through the dispatch seam, per jit entry
+#: (analysis/transfer.py sizes each row in closed form from the
+#: CONTRACTS grammar and gates the per-step totals against
+#: analysis/transfer_budget.json).  Row schema:
+#:   value    contract class name or inline contract string
+#:   param    entry parameter the upload binds (classification cross-check)
+#:   site     host qualname performing the crossing
+#:   tag      capacity.METER tag the site counts under
+#:   per_step crossing happens on EVERY step of this entry's profile
+#:   masked   download is lane/field-masked (the _LazyOut discipline)
+#:   cached   upload is memoized until invalidated (not per-step)
+#: ``_control`` rows are step-loop control-plane crossings (admissions,
+#: membership, telemetry) that belong to no single entry.
+TRANSFER_LEDGER = {
+    "step": {
+        "resident": ("ShardState",),
+        "up": (
+            {"value": "Inbox", "param": "inbox",
+             "site": "_InboxBuilder.to_device", "tag": "inbox_up",
+             "per_step": True},
+            {"value": "StepInput", "param": "inp",
+             "site": "_InputBuilder.to_device", "tag": "input_up",
+             "per_step": True},
+        ),
+        "down": (
+            {"value": "[G, 8] bool",
+             "site": "KernelEngine._process_outputs",
+             "tag": "output_flags", "per_step": True},
+            {"value": "StepOutput", "site": "_LazyOut.__getitem__",
+             "tag": "lazy_out", "per_step": False, "masked": True},
+            {"value": "[G, CAP] i32",
+             "site": "KernelEngine._process_outputs", "tag": "lt_rows",
+             "per_step": False, "masked": True},
+        ),
+    },
+    "step_donated": {
+        "resident": ("ShardState",),
+        "up": (
+            {"value": "Inbox", "param": "inbox",
+             "site": "_InboxBuilder.to_device", "tag": "inbox_up",
+             "per_step": True},
+            {"value": "StepInput", "param": "inp",
+             "site": "_InputBuilder.to_device", "tag": "input_up",
+             "per_step": True},
+        ),
+        "down": (
+            {"value": "[G, 8] bool",
+             "site": "KernelEngine._process_outputs",
+             "tag": "output_flags", "per_step": True},
+            {"value": "StepOutput", "site": "_LazyOut.__getitem__",
+             "tag": "lazy_out", "per_step": False, "masked": True},
+            {"value": "[G, CAP] i32",
+             "site": "KernelEngine._process_outputs", "tag": "lt_rows",
+             "per_step": False, "masked": True},
+        ),
+    },
+    "serve_step": {
+        "resident": ("ShardState", "Inbox"),
+        "up": (
+            {"value": "StepInput", "param": "inp",
+             "site": "_InputBuilder.to_device", "tag": "input_up",
+             "per_step": True},
+            {"value": "[G] bool", "param": "cut",
+             "site": "MeshDispatch.dispatch", "tag": "cut_up",
+             "per_step": False, "cached": True},
+        ),
+        "down": (
+            {"value": "[] i32", "site": "MeshDispatch.pending",
+             "tag": "mesh_pending", "per_step": True},
+            {"value": "[G, 8] bool",
+             "site": "KernelEngine._process_outputs",
+             "tag": "output_flags", "per_step": True},
+            {"value": "StepOutput", "site": "_LazyOut.__getitem__",
+             "tag": "lazy_out", "per_step": False, "masked": True},
+            {"value": "[G, CAP] i32",
+             "site": "KernelEngine._process_outputs", "tag": "lt_rows",
+             "per_step": False, "masked": True},
+        ),
+    },
+    "serve_step_donated": {
+        "resident": ("ShardState", "Inbox"),
+        "up": (
+            {"value": "StepInput", "param": "inp",
+             "site": "_InputBuilder.to_device", "tag": "input_up",
+             "per_step": True},
+            {"value": "[G] bool", "param": "cut",
+             "site": "MeshDispatch.dispatch", "tag": "cut_up",
+             "per_step": False, "cached": True},
+        ),
+        "down": (
+            {"value": "[] i32", "site": "MeshDispatch.pending",
+             "tag": "mesh_pending", "per_step": True},
+            {"value": "[G, 8] bool",
+             "site": "KernelEngine._process_outputs",
+             "tag": "output_flags", "per_step": True},
+            {"value": "StepOutput", "site": "_LazyOut.__getitem__",
+             "tag": "lazy_out", "per_step": False, "masked": True},
+            {"value": "[G, CAP] i32",
+             "site": "KernelEngine._process_outputs", "tag": "lt_rows",
+             "per_step": False, "masked": True},
+        ),
+    },
+    "fleet_stats": {
+        "resident": ("ShardState",),
+        "up": (
+            {"value": "[G, K] i32", "param": "inbox_from",
+             "site": "KernelEngine._collect_fleet_stats",
+             "tag": "fleet_down", "per_step": False},
+        ),
+        "down": (
+            {"value": "FleetStats",
+             "site": "KernelEngine._collect_fleet_stats",
+             "tag": "fleet_down", "per_step": False},
+        ),
+    },
+    "fleet_health": {
+        "resident": ("ShardState", "HealthDigest"),
+        "up": (
+            {"value": "[G, K] i32", "param": "inbox_from",
+             "site": "KernelEngine._collect_health",
+             "tag": "health_down", "per_step": False},
+        ),
+        "down": (
+            {"value": "HealthReport",
+             "site": "KernelEngine._collect_health",
+             "tag": "health_down", "per_step": False},
+        ),
+    },
+    "check_invariants": {
+        "resident": ("ShardState", "InvariantDigest"),
+        "up": (
+            {"value": "[G] i32",
+             "site": "KernelEngine._collect_invariants",
+             "tag": "invariants_down", "per_step": False},
+        ),
+        "down": (
+            {"value": "InvariantReport",
+             "site": "KernelEngine._collect_invariants",
+             "tag": "invariants_down", "per_step": False},
+        ),
+    },
+    "_control": (
+        {"value": "ShardState", "dir": "up",
+         "site": "KernelEngine._flush_injections", "tag": "inject_up",
+         "per_step": False},
+        {"value": "[G, P] i32", "dir": "up",
+         "site": "KernelEngine.update_lane_membership",
+         "tag": "membership_up", "per_step": False},
+        {"value": "[G, P] i32", "dir": "up",
+         "site": "MeshEngine.update_lane_membership",
+         "tag": "membership_up", "per_step": False},
+        {"value": "ShardRow", "dir": "down",
+         "site": "KernelEngine.health_row", "tag": "health_row",
+         "per_step": False},
+        {"value": "[G] i32", "dir": "down",
+         "site": "KernelEngine._emit_messages", "tag": "wit_snap_floor",
+         "per_step": False},
+    ),
+}
+
 
 class SerialDispatch:
     """Single-device backend: inbox re-staged from host every step."""
@@ -217,7 +409,8 @@ class MeshDispatch:
         cl = self.cluster
         staged = cl.shard(inp.to_device())
         if self._cut_dev is None:
-            self._cut_dev = cl.shard(jnp.asarray(self.cut))
+            with _capacity.METER.sanctioned("cut_up"):
+                self._cut_dev = cl.shard(jnp.asarray(self.cut))
         entry = self.entries["serve_step_donated" if donate
                              else "serve_step"]
         state, box, out, pending = entry(
@@ -232,7 +425,8 @@ class MeshDispatch:
         p = self._pending_dev
         if p is not None:
             self._pending_dev = None
-            self._pending_msgs = int(p)
+            with _capacity.METER.sanctioned("mesh_pending"):
+                self._pending_msgs = int(p)
         return self._pending_msgs > 0
 
     def inbox_from(self, inbox_buf):

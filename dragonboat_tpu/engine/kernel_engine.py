@@ -50,6 +50,7 @@ from dataclasses import dataclass, field, replace as _dc_replace
 import jax.numpy as jnp
 import numpy as np
 
+from dragonboat_tpu import capacity as _capacity
 from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.tracing import annotate, stop_env_trace
@@ -110,7 +111,8 @@ class _LazyOut:
     def __getitem__(self, f: str) -> np.ndarray:
         v = self._np.get(f)
         if v is None:
-            v = np.asarray(getattr(self._out, f))
+            with _capacity.METER.sanctioned("lazy_out"):
+                v = np.asarray(getattr(self._out, f))
             self._np[f] = v
         return v
 
@@ -546,7 +548,7 @@ class KernelEngine:
         items = sorted(self._pending_inject.items())
         self._pending_inject = {}
         n = len(items)
-        lanes = jnp.asarray(np.array([g for g, _ in items], np.int32))
+        lanes_np = np.array([g for g, _ in items], np.int32)
         f32 = {k: np.zeros((n,), np.int32) for k in (
             "replica_id", "seed", "rand_timeout", "e_timeout", "h_timeout",
             "role", "term", "vote", "applied", "snap_index", "snap_term",
@@ -595,60 +597,62 @@ class KernelEngine:
             f32["last"][j] = last
             f32["committed"][j] = init.committed
         s = self.state
-        A = {k: jnp.asarray(v) for k, v in {**f32, **fb}.items()}
+        with _capacity.METER.sanctioned("inject_up"):
+            lanes = jnp.asarray(lanes_np)
+            A = {k: jnp.asarray(v) for k, v in {**f32, **fb}.items()}
 
-        def put(arr, vals):
-            # route sub-32-bit scatters through int32: non-uniform-index
-            # scatters on bool operands silently drop writes on TPU past
-            # ~3k rows (the _set1 miscompile, core/kernel.py) — an
-            # admission batch is exactly that shape
-            if arr.dtype == jnp.bool_:
-                vals_i = jnp.asarray(vals).astype(jnp.int32)
-                return (arr.astype(jnp.int32).at[lanes].set(vals_i)
-                        .astype(bool))
-            return arr.at[lanes].set(vals)
+            def put(arr, vals):
+                # route sub-32-bit scatters through int32: non-uniform-
+                # index scatters on bool operands silently drop writes on
+                # TPU past ~3k rows (the _set1 miscompile, core/kernel.py)
+                # — an admission batch is exactly that shape
+                if arr.dtype == jnp.bool_:
+                    vals_i = jnp.asarray(vals).astype(jnp.int32)
+                    return (arr.astype(jnp.int32).at[lanes].set(vals_i)
+                            .astype(bool))
+                return arr.at[lanes].set(vals)
 
-        last_v = A["last"]
-        self.state = s._replace(
-            replica_id=put(s.replica_id, A["replica_id"]),
-            seed=put(s.seed, A["seed"]),
-            rand_timeout=put(s.rand_timeout, A["rand_timeout"]),
-            rand_counter=put(s.rand_counter, 0),
-            e_timeout=put(s.e_timeout, A["e_timeout"]),
-            h_timeout=put(s.h_timeout, A["h_timeout"]),
-            check_quorum=put(s.check_quorum, A["check_quorum"]),
-            pre_vote=put(s.pre_vote, A["pre_vote"]),
-            role=put(s.role, A["role"]),
-            term=put(s.term, A["term"]),
-            vote=put(s.vote, A["vote"]),
-            leader=put(s.leader, 0),
-            applied=put(s.applied, A["applied"]),
-            e_tick=put(s.e_tick, 0),
-            h_tick=put(s.h_tick, 0),
-            pending_cc=put(s.pending_cc, False),
-            ltt=put(s.ltt, 0),
-            is_ltt=put(s.is_ltt, False),
-            pid=put(s.pid, jnp.asarray(pid_rows)),
-            kind=put(s.kind, jnp.asarray(kind_rows)),
-            match=put(s.match, 0),
-            next=put(s.next, (last_v + 1)[:, None]),
-            pstate=put(s.pstate, KP.R_RETRY),
-            active=put(s.active, False),
-            psnap=put(s.psnap, 0),
-            vresp=put(s.vresp, False),
-            vgrant=put(s.vgrant, False),
-            lt=put(s.lt, jnp.asarray(lt_rows)),
-            lcc=put(s.lcc, jnp.asarray(lcc_rows)),
-            snap_index=put(s.snap_index, A["snap_index"]),
-            snap_term=put(s.snap_term, A["snap_term"]),
-            last=put(s.last, last_v),
-            committed=put(s.committed, A["committed"]),
-            processed=put(s.processed, A["applied"]),
-            stable=put(s.stable, last_v),
-            ri_head=put(s.ri_head, 0),
-            ri_count=put(s.ri_count, 0),
-            needs_host=put(s.needs_host, False),
-        )
+            last_v = A["last"]
+            self.state = s._replace(
+                replica_id=put(s.replica_id, A["replica_id"]),
+                seed=put(s.seed, A["seed"]),
+                rand_timeout=put(s.rand_timeout, A["rand_timeout"]),
+                rand_counter=put(s.rand_counter, 0),
+                e_timeout=put(s.e_timeout, A["e_timeout"]),
+                h_timeout=put(s.h_timeout, A["h_timeout"]),
+                check_quorum=put(s.check_quorum, A["check_quorum"]),
+                pre_vote=put(s.pre_vote, A["pre_vote"]),
+                role=put(s.role, A["role"]),
+                term=put(s.term, A["term"]),
+                vote=put(s.vote, A["vote"]),
+                leader=put(s.leader, 0),
+                applied=put(s.applied, A["applied"]),
+                e_tick=put(s.e_tick, 0),
+                h_tick=put(s.h_tick, 0),
+                pending_cc=put(s.pending_cc, False),
+                ltt=put(s.ltt, 0),
+                is_ltt=put(s.is_ltt, False),
+                pid=put(s.pid, jnp.asarray(pid_rows)),
+                kind=put(s.kind, jnp.asarray(kind_rows)),
+                match=put(s.match, 0),
+                next=put(s.next, (last_v + 1)[:, None]),
+                pstate=put(s.pstate, KP.R_RETRY),
+                active=put(s.active, False),
+                psnap=put(s.psnap, 0),
+                vresp=put(s.vresp, False),
+                vgrant=put(s.vgrant, False),
+                lt=put(s.lt, jnp.asarray(lt_rows)),
+                lcc=put(s.lcc, jnp.asarray(lcc_rows)),
+                snap_index=put(s.snap_index, A["snap_index"]),
+                snap_term=put(s.snap_term, A["snap_term"]),
+                last=put(s.last, last_v),
+                committed=put(s.committed, A["committed"]),
+                processed=put(s.processed, A["applied"]),
+                stable=put(s.stable, last_v),
+                ri_head=put(s.ri_head, 0),
+                ri_count=put(s.ri_count, 0),
+                needs_host=put(s.needs_host, False),
+            )
 
     def _clear_lane(self, lane: int) -> None:
         self._inv_dirty.add(lane)
@@ -701,9 +705,11 @@ class KernelEngine:
                 i += 1
         g = node.lane
         s = self.state
+        with _capacity.METER.sanctioned("membership_up"):
+            jp, jk = jnp.asarray(pids), jnp.asarray(kinds)
         self.state = s._replace(
-            pid=s.pid.at[g].set(jnp.asarray(pids)),
-            kind=s.kind.at[g].set(jnp.asarray(kinds)),
+            pid=s.pid.at[g].set(jp),
+            kind=s.kind.at[g].set(jk),
             # the applied CC releases the one-in-flight gate (pycore
             # add_node/add_non_voting/... clear pending_config_change on
             # apply; without this a lane accepts exactly ONE config
@@ -962,9 +968,10 @@ class KernelEngine:
         exactly the state the step produced."""
         from dragonboat_tpu.core import fleet as _fleet
 
-        stats = self._cap_entries["fleet_stats"](
-            self.state, self._fleet_inbox_from())
-        self.last_fleet = _fleet.stats_to_dict(stats)
+        with _capacity.METER.sanctioned("fleet_down"):
+            stats = self._cap_entries["fleet_stats"](
+                self.state, self._fleet_inbox_from())
+            self.last_fleet = _fleet.stats_to_dict(stats)
 
     def _make_health_digest(self):
         """Fresh all-zero digest matching the engine's lane geometry,
@@ -987,11 +994,12 @@ class KernelEngine:
 
         if self._health_digest is None:
             self._health_digest = self._make_health_digest()
-        report, self._health_digest = self._cap_entries["fleet_health"](
-            self.state, self._fleet_inbox_from(), self._health_digest,
-            thresholds=self.health_thresholds, k=self.health_top_k)
+        with _capacity.METER.sanctioned("health_down"):
+            report, self._health_digest = self._cap_entries["fleet_health"](
+                self.state, self._fleet_inbox_from(), self._health_digest,
+                thresholds=self.health_thresholds, k=self.health_top_k)
+            cur = _health.report_to_dict(report)
         prev = self.last_health
-        cur = _health.report_to_dict(report)
         self._health_seq += 1
         self.last_health = cur
         prev_counts = prev["class_count"] if prev else {}
@@ -1027,16 +1035,18 @@ class KernelEngine:
 
         if self._inv_digest is None:
             self._inv_digest = self._make_invariant_digest()
-        if self._inv_dirty:
-            lanes = jnp.asarray(
-                np.array(sorted(self._inv_dirty), np.int32))
-            self._inv_dirty.clear()
-            d = self._inv_digest
-            self._inv_digest = d._replace(ticks=d.ticks.at[lanes].set(0))
-        report, self._inv_digest = self._cap_entries["check_invariants"](
-            self.state, self._inv_digest)
+        with _capacity.METER.sanctioned("invariants_down"):
+            if self._inv_dirty:
+                lanes = jnp.asarray(
+                    np.array(sorted(self._inv_dirty), np.int32))
+                self._inv_dirty.clear()
+                d = self._inv_digest
+                self._inv_digest = d._replace(
+                    ticks=d.ticks.at[lanes].set(0))
+            report, self._inv_digest = self._cap_entries[
+                "check_invariants"](self.state, self._inv_digest)
+            cur = _invariants.report_to_dict(report)
         prev = self.last_invariants
-        cur = _invariants.report_to_dict(report)
         self._inv_seq += 1
         self._inv_violations_seen += cur["total"]
         cur["violations_seen"] = self._inv_violations_seen
@@ -1127,10 +1137,12 @@ class KernelEngine:
         with self.mu:
             if self._health_digest is None:
                 self._health_digest = self._make_health_digest()
-            row = _health.shard_row(
-                self.state, self._fleet_inbox_from(), self._health_digest,
-                np.int32(lane), thresholds=self.health_thresholds)
-        return _health.row_to_dict(row)
+            with _capacity.METER.sanctioned("health_row"):
+                row = _health.shard_row(
+                    self.state, self._fleet_inbox_from(),
+                    self._health_digest, np.int32(lane),
+                    thresholds=self.health_thresholds)
+                return _health.row_to_dict(row)
 
     def _kernel_call(self, inbox: _InboxBuilder, inp: _InputBuilder):
         # depth > 0 routes through the backend's donating entry: XLA
@@ -1322,7 +1334,8 @@ class KernelEngine:
         nodes, out = ctx.nodes, ctx.out
         for k in ctx.traced:
             lifecycle.TRACER.stamp(k, lifecycle.STAGE_RETIRE)
-        flags = np.asarray(output_row_flags(out))
+        with _capacity.METER.sanctioned("output_flags"):
+            flags = np.asarray(output_row_flags(out))
         o = _LazyOut(out)
         pid = self._pid_np
         kind = self._kind_np
@@ -1370,9 +1383,10 @@ class KernelEngine:
                      if o["save_last"][g] >= o["save_first"][g]]
         lt_rows = {}
         if save_rows:
-            idx = jnp.asarray(np.asarray(save_rows, np.int32))
-            lt_rows = dict(zip(save_rows,
-                               np.asarray(self.state.lt[idx])))
+            with _capacity.METER.sanctioned("lt_rows"):
+                idx = jnp.asarray(np.asarray(save_rows, np.int32))
+                lt_rows = dict(zip(save_rows,
+                                   np.asarray(self.state.lt[idx])))
 
         for g, n in cand:
             # 1. proposal fates (origin holds the future's books — on a
@@ -1514,7 +1528,8 @@ class KernelEngine:
                 # stale older record would leave a gap the witness can
                 # never bridge (re-sent forever) — evict instead.
                 ss = n.logdb.get_snapshot(n.shard_id, n.replica_id)
-                floor = int(self.state.snap_index[g])  # rare: wit_snap only
+                with _capacity.METER.sanctioned("wit_snap_floor"):
+                    floor = int(self.state.snap_index[g])  # wit_snap only
                 if ss is not None and not ss.is_empty() \
                         and ss.index >= floor:
                     others.append((n, pb.Message(
@@ -1802,17 +1817,21 @@ class _InboxBuilder:
         return True
 
     def to_device(self) -> Inbox:
-        return Inbox(
-            mtype=jnp.asarray(self.mtype), from_=jnp.asarray(self.from_),
-            term=jnp.asarray(self.term), log_term=jnp.asarray(self.log_term),
-            log_index=jnp.asarray(self.log_index),
-            commit=jnp.asarray(self.commit), reject=jnp.asarray(self.reject),
-            hint=jnp.asarray(self.hint),
-            hint_high=jnp.asarray(self.hint_high),
-            n_ent=jnp.asarray(self.n_ent),
-            ent_term=jnp.asarray(self.ent_term),
-            ent_cc=jnp.asarray(self.ent_cc),
-        )
+        with _capacity.METER.sanctioned("inbox_up"):
+            return Inbox(
+                mtype=jnp.asarray(self.mtype),
+                from_=jnp.asarray(self.from_),
+                term=jnp.asarray(self.term),
+                log_term=jnp.asarray(self.log_term),
+                log_index=jnp.asarray(self.log_index),
+                commit=jnp.asarray(self.commit),
+                reject=jnp.asarray(self.reject),
+                hint=jnp.asarray(self.hint),
+                hint_high=jnp.asarray(self.hint_high),
+                n_ent=jnp.asarray(self.n_ent),
+                ent_term=jnp.asarray(self.ent_term),
+                ent_cc=jnp.asarray(self.ent_cc),
+            )
 
 
 class _InputBuilder:
@@ -1851,14 +1870,15 @@ class _InputBuilder:
         self._applied[g] = v
 
     def to_device(self) -> StepInput:
-        return StepInput(
-            prop_valid=jnp.asarray(self.prop_valid),
-            prop_cc=jnp.asarray(self.prop_cc),
-            ri_valid=jnp.asarray(self.ri_valid),
-            ri_low=jnp.asarray(self.ri_low),
-            ri_high=jnp.asarray(self.ri_high),
-            transfer_to=jnp.asarray(self.transfer_to),
-            tick=jnp.asarray(self._tick),
-            quiesced=jnp.zeros_like(self._tick),
-            applied=jnp.asarray(self._applied),
-        )
+        with _capacity.METER.sanctioned("input_up"):
+            return StepInput(
+                prop_valid=jnp.asarray(self.prop_valid),
+                prop_cc=jnp.asarray(self.prop_cc),
+                ri_valid=jnp.asarray(self.ri_valid),
+                ri_low=jnp.asarray(self.ri_low),
+                ri_high=jnp.asarray(self.ri_high),
+                transfer_to=jnp.asarray(self.transfer_to),
+                tick=jnp.asarray(self._tick),
+                quiesced=jnp.zeros_like(self._tick),
+                applied=jnp.asarray(self._applied),
+            )

@@ -38,6 +38,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from dragonboat_tpu import capacity as _capacity
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.config import MeshSpec
 from dragonboat_tpu.core import params as KP
@@ -293,7 +294,8 @@ class MeshEngine(KernelEngine):
             for rid in sorted(m.non_votings):
                 pids[i], kinds[i] = rid, KP.K_NON_VOTING
                 i += 1
-            jp, jk = jax.numpy.asarray(pids), jax.numpy.asarray(kinds)
+            with _capacity.METER.sanctioned("membership_up"):
+                jp, jk = jax.numpy.asarray(pids), jax.numpy.asarray(kinds)
             for member in list(self._members.get(node.shard_id, {}).values()):
                 s = s._replace(
                     pid=s.pid.at[member.lane].set(jp),

@@ -99,6 +99,35 @@ def jax_cache_dir(prefix: str = "/tmp/dragonboat_tpu_jax_cache") -> str:
     return f"{prefix}_{hashlib.md5(line.encode()).hexdigest()[:8]}"
 
 
+def purge_donated_cache_entries(cache_dir: str) -> int:
+    """Drop persisted executables for DONATED jit entries; return count.
+
+    Diagnosed 2026-08-08 on jax 0.4.37 / XLA:CPU: an executable compiled
+    with ``donate_argnums`` round-trips through the persistent cache
+    with broken buffer aliasing — the DESERIALIZED executable returns
+    wrong results (diverging state a few steps in) and then segfaults
+    or aborts (``std::bad_function_call``) when a result buffer is read.
+    A freshly compiled donated executable is fine, and re-running the
+    same entry in the same process is fine — only the load-from-disk
+    path is affected.  Until the toolchain moves, donated entries are
+    treated as non-cacheable: every process that points jax at the
+    cache purges them first, paying the recompile instead of the
+    use-after-free.  The repo's donated entries all carry the
+    ``_donated`` suffix (enforced by the engine-unity pass's
+    DISPATCH_ENTRIES contract), so the purge keys on the persisted
+    filename."""
+    import glob
+
+    n = 0
+    for path in glob.glob(os.path.join(cache_dir, "*_donated-*")):
+        try:
+            os.remove(path)
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
 def enable_compile_cache(
     min_compile_secs: float = 1.0,
     prefix: str = "/tmp/dragonboat_tpu_jax_cache",
@@ -115,6 +144,7 @@ def enable_compile_cache(
     import jax
 
     cache_dir = jax_cache_dir(prefix)
+    purge_donated_cache_entries(cache_dir)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       float(min_compile_secs))

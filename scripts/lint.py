@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis runner: the eight lint passes over the repo.
+"""Static-analysis runner: the nine lint passes over the repo.
 
 Passes (dragonboat_tpu/analysis/):
 
@@ -40,6 +40,21 @@ Passes (dragonboat_tpu/analysis/):
                   small-scope exhaustive model check of the real jitted
                   kernel step (scripts/model_check.py fast scope,
                   RS005)
+  transfer        the device<->host boundary as a checked contract:
+                  every crossing into/out of the jitted dispatch
+                  entries declared in engine/dispatch.py
+                  TRANSFER_LEDGER and sized in closed form from the
+                  CONTRACTS grammar — undeclared crossings (TB001),
+                  per-step byte budgets vs
+                  analysis/transfer_budget.json (TB002), unmasked wide
+                  downloads outside the _LazyOut path (TB003), uploads
+                  bypassing the staging builders (TB004), syncs outside
+                  the declared SYNC_POINTS (TB005, the engine-wide
+                  sharpening of PS006), per-step crossing-count growth
+                  (TB006), plus a dynamic leg that steps the real
+                  dispatch seams under jax.transfer_guard("disallow")
+                  at three geometries and diffs the live METER counts
+                  against the static ledger
 
 Passes run in parallel worker processes (one fork per pass; jax
 initializes per-child so the AST-only passes never pay for it).  Use
@@ -67,7 +82,9 @@ loops with `--pass` selecting the AST passes, or refresh its budget
 after a justified kernel change with `--reseed-hlo-budget` (then
 record why in PERF.md).  The partition pass's dynamic mesh check
 caches the same way (analysis/.partition_cache.json), as does the
-safety pass's model-check gate (analysis/.safety_cache.json).
+safety pass's model-check gate (analysis/.safety_cache.json) and the
+transfer pass's live seam diff (analysis/.transfer_cache.json); the
+transfer budget reseeds with `--reseed-transfer-budget`.
 """
 
 from __future__ import annotations
@@ -101,6 +118,7 @@ from dragonboat_tpu.analysis import (  # noqa: E402
     partition,
     safety,
     tracer_safety,
+    transfer,
 )
 
 PASSES = {
@@ -112,6 +130,7 @@ PASSES = {
     "partition": partition.run,
     "engine-unity": engine_unity.run,
     "safety": safety.run,
+    "transfer": transfer.run,
 }
 
 # repo-relative inputs of each pass, for --changed-only (entries may be
@@ -126,6 +145,7 @@ PASS_SCOPES = {
     "partition": partition.SCOPE,
     "engine-unity": engine_unity.SCOPE,
     "safety": safety.SCOPE,
+    "transfer": transfer.SCOPE,
 }
 
 WAIVERS_FILE = "dragonboat_tpu/analysis/waivers.toml"
@@ -308,11 +328,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--reseed-hlo-budget", action="store_true",
                     help="re-measure the kernel and overwrite "
                          "analysis/hlo_budget.json (justify in PERF.md)")
+    ap.add_argument("--reseed-transfer-budget", action="store_true",
+                    help="re-size the declared transfer ledger and "
+                         "overwrite analysis/transfer_budget.json "
+                         "(justify in PERF.md)")
     args = ap.parse_args(argv)
 
     if args.reseed_hlo_budget:
         spec = hlo_budget.reseed(ROOT)
         print(f"reseeded {hlo_budget.BUDGET_FILE}:")
+        print(json.dumps(spec["budget"], indent=2, sort_keys=True))
+        return 0
+
+    if args.reseed_transfer_budget:
+        spec = transfer.reseed(ROOT)
+        print(f"reseeded {transfer.BUDGET_FILE}:")
         print(json.dumps(spec["budget"], indent=2, sort_keys=True))
         return 0
 

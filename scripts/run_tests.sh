@@ -46,6 +46,10 @@ lint() {
     # the json run one line up
     PYTHONPATH= "$PY" scripts/lint.py --format sarif "$@" \
         > "$SARIF_ARTIFACT" || true
+    # the sized device<->host crossing inventory (ROADMAP item 2's
+    # work-list); CI uploads it next to the findings
+    PYTHONPATH= "$PY" -m dragonboat_tpu.analysis.transfer . \
+        > /dev/null 2>&1 || true
     PYTHONPATH= "$PY" scripts/lint_summary.py "$ARTIFACT"
 }
 # `run_tests.sh lint-fast`: the tight-edit-loop entry — only the lint
@@ -55,12 +59,13 @@ if [ "${1:-}" = "lint-fast" ]; then
     lint --changed-only
     exit $?
 fi
-# fast pre-test stage: the seven static-analysis passes (scripts/lint.py;
+# fast pre-test stage: the nine static-analysis passes (scripts/lint.py;
 # ~2 s when kernel sources are unchanged — the hlo-budget compile result
 # is cached in analysis/.hlo_budget_cache.json keyed by a source hash,
 # and the partition pass's 2-device mesh check likewise in
 # analysis/.partition_cache.json, the safety pass's model-check gate
-# in analysis/.safety_cache.json — and ~20 s after a kernel edit).
+# in analysis/.safety_cache.json, the transfer pass's live seam diff in
+# analysis/.transfer_cache.json — and ~20 s after a kernel edit).
 # After a justified kernel change that shifts the
 # gather/scatter/while counts: `python scripts/lint.py
 # --reseed-hlo-budget`, review the analysis/hlo_budget.json diff, and

@@ -40,9 +40,17 @@ jax.config.update("jax_platform_name", "cpu")
 # persistent compile cache: the batched step kernel takes ~10-30s to compile;
 # cache it across pytest runs.  The dir is fingerprinted by CPU features
 # (build rounds hop machines — hostenv.jax_cache_dir)
-from dragonboat_tpu.hostenv import jax_cache_dir as _jax_cache_dir
+from dragonboat_tpu.hostenv import (  # noqa: E402
+    jax_cache_dir as _jax_cache_dir,
+    purge_donated_cache_entries as _purge_donated,
+)
 
-jax.config.update("jax_compilation_cache_dir", _jax_cache_dir())
+_cache_dir = _jax_cache_dir()
+# donated executables must compile fresh each process: jax 0.4.37's cache
+# DESERIALIZATION breaks their buffer aliasing (wrong results, then a
+# segfault on the first result read) — see hostenv.purge_donated_cache_entries
+_purge_donated(_cache_dir)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

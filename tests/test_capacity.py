@@ -431,6 +431,27 @@ def test_compile_cache_env_veto(monkeypatch):
     assert hostenv.jax_cache_dir("/tmp/x").startswith("/tmp/x_")
 
 
+def test_donated_cache_purge(tmp_path):
+    """Persisted executables for donated entries are purged whenever a
+    process points jax at the cache: jax 0.4.37's deserialization
+    breaks donated-buffer aliasing (wrong results, then a segfault on
+    the first result read), so donated entries must compile fresh in
+    every process.  Non-donated entries stay cached."""
+    from dragonboat_tpu import hostenv
+
+    keep = tmp_path / "jit_step-aaaa-cache"
+    drop1 = tmp_path / "jit_step_donated-bbbb-cache"
+    drop2 = tmp_path / "jit_jit_serve_step_donated-cccc-atime"
+    for p in (keep, drop1, drop2):
+        p.write_bytes(b"x")
+    n = hostenv.purge_donated_cache_entries(str(tmp_path))
+    assert n == 2
+    assert keep.exists() and not drop1.exists() and not drop2.exists()
+    # idempotent on an already-clean (or missing) dir
+    assert hostenv.purge_donated_cache_entries(str(tmp_path)) == 0
+    assert hostenv.purge_donated_cache_entries(str(tmp_path / "nope")) == 0
+
+
 # ---------------------------------------------------------------------
 # endpoints + doctor CLIs (synthetic sources, no cluster)
 

@@ -27,13 +27,23 @@ mesh's device-side pending count must equal the router inbox's
 occupancy.  Runs on the forced multi-device CPU mesh (conftest sets
 xla_force_host_platform_device_count=8); skips when fewer than 2
 devices are available.
+
+Both loops run under ``capacity.METER.guard()``
+(``jax.transfer_guard("disallow")``) from step 1 on: step 0 compiles
+the jit entries, after that every device<->host crossing the loop
+makes is declared through ``METER.sanctioned`` — an undeclared one
+(a numpy tree slipping into a jit call, an implicit ``int()`` of a
+device scalar) raises instead of silently round-tripping the host.
 """
+
+import contextlib
 
 import jax
 import numpy as np
 import pytest
 from jax.sharding import Mesh
 
+from dragonboat_tpu import capacity as _capacity
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.router import cluster_step, cluster_step_donated
 from dragonboat_tpu.parallel.ici import (
@@ -149,24 +159,42 @@ def test_engine_kernel_paths_bitwise_equal(seed):
     # paths consume (mesh side via iperm), so the schedules are identical
     rng = np.random.default_rng(seed)
     committed = 0
-    for step_no in range(STEPS):
-        draws = rng.bit_generator.state  # rewind point: same draws twice
-        inp_r = _random_input(kp, rng, _pull(state_r), None)
-        rng.bit_generator.state = draws
-        inp_m = _random_input(kp, rng, _pull(state_m), iperm)
+    guard = contextlib.ExitStack()  # entered after the compile step
+    try:
+        for step_no in range(STEPS):
+            draws = rng.bit_generator.state  # rewind: same draws twice
+            with _capacity.METER.sanctioned("retire"):
+                st_r_np, st_m_np = _pull(state_r), _pull(state_m)
+            inp_r = _random_input(kp, rng, st_r_np, None)
+            rng.bit_generator.state = draws
+            inp_m = _random_input(kp, rng, st_m_np, iperm)
+            # explicit staging: a numpy tree into a jit call is exactly
+            # what the guard exists to catch
+            with _capacity.METER.sanctioned("input_up"):
+                inp_m_dev = cluster.shard(inp_m)
+                inp_r_dev = jax.device_put(inp_r)
 
-        state_m, box_m, _, pending = ici_serve_step(
-            cluster, state_m, box_m, cluster.shard(inp_m), cut)
-        state_r, box_r, _ = cluster_step(kp, REPLICAS, state_r, box_r, inp_r)
+            state_m, box_m, _, pending = ici_serve_step(
+                cluster, state_m, box_m, inp_m_dev, cut)
+            state_r, box_r, _ = cluster_step(
+                kp, REPLICAS, state_r, box_r, inp_r_dev)
 
-        _assert_equal(f"seed {seed} step {step_no} state",
-                      _permute(_pull(state_m), perm), _pull(state_r))
-        _assert_equal(f"seed {seed} step {step_no} box",
-                      _permute(_pull(box_m), perm), _pull(box_r))
-        # the mesh's device-side pending count is the router occupancy
-        assert int(pending) == int((np.asarray(box_r.mtype) != 0).sum()), (
-            f"seed {seed} step {step_no}: pending diverged")
-        committed = int(np.asarray(state_r.committed).max())
+            with _capacity.METER.sanctioned("retire"):
+                _assert_equal(f"seed {seed} step {step_no} state",
+                              _permute(_pull(state_m), perm),
+                              _pull(state_r))
+                _assert_equal(f"seed {seed} step {step_no} box",
+                              _permute(_pull(box_m), perm), _pull(box_r))
+                occupancy = int((np.asarray(box_r.mtype) != 0).sum())
+                committed = int(np.asarray(state_r.committed).max())
+            # the mesh's device-side pending count is the router occupancy
+            with _capacity.METER.sanctioned("mesh_pending"):
+                assert int(pending) == occupancy, (
+                    f"seed {seed} step {step_no}: pending diverged")
+            if step_no == 0:
+                guard.enter_context(_capacity.METER.guard())
+    finally:
+        guard.close()
     assert committed > 0, "randomized differential ran but never committed"
 
 
@@ -196,34 +224,47 @@ def test_engine_kernel_paths_bitwise_equal_depth1(seed):
     rng = np.random.default_rng(seed)
     committed = 0
     pending_dev = None
-    for step_no in range(STEPS):
-        # retire step N-1: pull BEFORE dispatch — after the donating
-        # call the old device buffers belong to XLA
-        st_m_mesh = _pull(state_m)
-        st_m = _permute(st_m_mesh, perm)
-        bx_m = _permute(_pull(box_m), perm)
-        st_r = _pull(state_r)
-        bx_r = _pull(box_r)
-        _assert_equal(f"seed {seed} step {step_no} state (depth1)",
-                      st_m, st_r)
-        _assert_equal(f"seed {seed} step {step_no} box (depth1)",
-                      bx_m, bx_r)
-        if pending_dev is not None:
-            # the deferred device scalar from step N-1's dispatch must
-            # equal the router inbox occupancy after step N-1
-            assert int(pending_dev) == int((bx_r.mtype != 0).sum()), (
-                f"seed {seed} step {step_no}: pending diverged (depth1)")
-        committed = int(st_r.committed.max())
+    guard = contextlib.ExitStack()  # entered after the compile step
+    try:
+        for step_no in range(STEPS):
+            # retire step N-1: pull BEFORE dispatch — after the donating
+            # call the old device buffers belong to XLA
+            with _capacity.METER.sanctioned("retire"):
+                st_m_mesh = _pull(state_m)
+                bx_m = _permute(_pull(box_m), perm)
+                st_r = _pull(state_r)
+                bx_r = _pull(box_r)
+            st_m = _permute(st_m_mesh, perm)
+            _assert_equal(f"seed {seed} step {step_no} state (depth1)",
+                          st_m, st_r)
+            _assert_equal(f"seed {seed} step {step_no} box (depth1)",
+                          bx_m, bx_r)
+            if pending_dev is not None:
+                # the deferred device scalar from step N-1's dispatch
+                # must equal the router inbox occupancy after step N-1
+                with _capacity.METER.sanctioned("mesh_pending"):
+                    assert int(pending_dev) == int(
+                        (bx_r.mtype != 0).sum()), (
+                        f"seed {seed} step {step_no}: pending diverged "
+                        "(depth1)")
+            committed = int(st_r.committed.max())
 
-        draws = rng.bit_generator.state
-        inp_r = _random_input(kp, rng, st_r, None)
-        rng.bit_generator.state = draws
-        inp_m = _random_input(kp, rng, st_m_mesh, iperm)
+            draws = rng.bit_generator.state
+            inp_r = _random_input(kp, rng, st_r, None)
+            rng.bit_generator.state = draws
+            inp_m = _random_input(kp, rng, st_m_mesh, iperm)
+            with _capacity.METER.sanctioned("input_up"):
+                inp_m_dev = cluster.shard(inp_m)
+                inp_r_dev = jax.device_put(inp_r)
 
-        state_m, box_m, _, pending_dev = jit_serve_step_donated(
-            kp, cluster, state_m, box_m, cluster.shard(inp_m), cut)
-        state_r, box_r, _ = cluster_step_donated(
-            kp, REPLICAS, state_r, box_r, inp_r)
+            state_m, box_m, _, pending_dev = jit_serve_step_donated(
+                kp, cluster, state_m, box_m, inp_m_dev, cut)
+            state_r, box_r, _ = cluster_step_donated(
+                kp, REPLICAS, state_r, box_r, inp_r_dev)
+            if step_no == 0:
+                guard.enter_context(_capacity.METER.guard())
+    finally:
+        guard.close()
 
     # final retire: the last dispatched step must still agree
     _assert_equal(f"seed {seed} final state (depth1)",

@@ -8,7 +8,14 @@ bitwise-neutral: ``run_steps_pipelined(n)`` ≡ ``run_steps(2n)``
 leaf-for-leaf.  Phase plan mirrors test_diff_onehot_reads_lockstep:
 elect, drop storm, write load, mixed reads — ≥300 driven micro-steps,
 every state leaf (and the final inbox) compared bitwise at each phase
-end."""
+end.
+
+The comparison pass runs under ``capacity.METER.guard()``
+(``jax.transfer_guard("disallow")``): a warm pass compiles every loop
+entry and every scalar argument is pre-staged with ``jax.device_put``,
+so the guarded drives must execute with no undeclared device<->host
+crossing — a numpy scalar slipping into a jit call raises instead of
+silently re-staging every invocation."""
 
 import numpy as np
 import pytest
@@ -18,6 +25,7 @@ import pytest
 def test_diff_pipelined_lockstep(seed):
     import jax
 
+    from dragonboat_tpu import capacity as _capacity
     from dragonboat_tpu.bench_loop import (
         bench_params,
         elect_all,
@@ -33,36 +41,48 @@ def test_diff_pipelined_lockstep(seed):
     kp = bench_params(3)
     state0, box0 = elect_all(kp, 3, make_cluster(kp, 64, 3))
     snap = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+    # traced scalars staged once, outside the guard: each one passed as
+    # a raw Python/numpy scalar would be a fresh host->device transfer
+    # on every jit call
+    t_on = jax.device_put(True)
+    drop_p = jax.device_put(np.float32(0.25))
+    seed_dev = jax.device_put(np.int32(seed))
+    now0 = jax.device_put(np.int32(7))
+    reads0 = jax.device_put(np.int32(0))
+    width = max(1, kp.proposal_cap // 8)  # static argnum: never crosses
 
     def drive_serial():
         state, box = state0, box0
         snaps = [snap(state)]
-        state, box = run_steps_storm(kp, 3, 100, 0.25, seed, state, box)
+        state, box = run_steps_storm(kp, 3, 100, drop_p, seed_dev,
+                                     state, box)
         snaps.append(snap(state))
-        state, box = run_steps(kp, 3, 100, True, True, state, box)
+        state, box = run_steps(kp, 3, 100, t_on, t_on, state, box)
         snaps.append(snap(state))
         state, box, reads = run_steps_mixed(
-            kp, 3, 100, max(1, kp.proposal_cap // 8),
-            np.int32(7), state, box, np.int32(0))
+            kp, 3, 100, width, now0, state, box, reads0)
         snaps.append(snap(state))
-        return snaps, snap(box), int(reads)
+        with _capacity.METER.sanctioned("retire"):
+            return snaps, snap(box), int(reads)
 
     def drive_pipelined():
         state, box = state0, box0
         snaps = [snap(state)]
         state, box = run_steps_storm_pipelined(
-            kp, 3, 50, 0.25, seed, state, box)
+            kp, 3, 50, drop_p, seed_dev, state, box)
         snaps.append(snap(state))
-        state, box = run_steps_pipelined(kp, 3, 50, True, True, state, box)
+        state, box = run_steps_pipelined(kp, 3, 50, t_on, t_on, state, box)
         snaps.append(snap(state))
         state, box, reads = run_steps_mixed_pipelined(
-            kp, 3, 50, max(1, kp.proposal_cap // 8),
-            np.int32(7), state, box, np.int32(0))
+            kp, 3, 50, width, now0, state, box, reads0)
         snaps.append(snap(state))
-        return snaps, snap(box), int(reads)
+        with _capacity.METER.sanctioned("retire"):
+            return snaps, snap(box), int(reads)
 
-    a, box_a, reads_a = drive_serial()
-    b, box_b, reads_b = drive_pipelined()
+    drive_serial(), drive_pipelined()  # warm: compile outside the guard
+    with _capacity.METER.guard():
+        a, box_a, reads_a = drive_serial()
+        b, box_b, reads_b = drive_pipelined()
     phases = ("elect", "storm", "write", "mixed")
     for phase, sa, sb in zip(phases, a, b):
         for name, va, vb in zip(sa._fields, sa, sb):
