@@ -56,6 +56,11 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/lifecycle.py",
     "dragonboat_tpu/core/health.py",
     "dragonboat_tpu/capacity.py",
+    # the fleet controller: lockless BY CONTRACT (all state advances
+    # under the NodeHost tick, never from transport threads) — listed so
+    # the day it grows a lock, its streak/cooldown dicts must declare
+    # their guard like every other shared book
+    "dragonboat_tpu/control.py",
 )
 
 LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",

@@ -50,6 +50,11 @@ DEFAULT_GLOBS = (
     # the capacity rail too: the compile tracker's clock is injected,
     # flight records are stamped with call counts, never wall time
     "dragonboat_tpu/capacity.py",
+    # the elastic controller: decisions must be a pure function of the
+    # observation sequence (digest + seeded splitmix32 tie-break) so a
+    # replayed flight record reproduces every transfer — no wall clock,
+    # no unseeded RNG, no set-order dependence
+    "dragonboat_tpu/control.py",
 )
 
 WALL_CLOCK = {

@@ -86,6 +86,10 @@ DEFAULT_ANALYSIS_FILES = (
     "dragonboat_tpu/core/health.py",
     "dragonboat_tpu/core/invariants.py",
     "dragonboat_tpu/parallel/ici.py",
+    # the elastic controller consumes the fleet-health digest at host
+    # level and must STAY jax-free: any reduction/collective appearing
+    # here is a cross-G flow outside the two declared seams
+    "dragonboat_tpu/control.py",
 )
 DEFAULT_CONST_FILES = ("dragonboat_tpu/core/params.py",)
 #: PS005 walks shard_map bodies through these

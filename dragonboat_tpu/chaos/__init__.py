@@ -20,8 +20,10 @@ from dragonboat_tpu.chaos.faultplan import FaultEvent, FaultPlan
 from dragonboat_tpu.chaos.oracle import OracleReport, check_convergence
 from dragonboat_tpu.chaos.runner import (
     DetectorResult,
+    HotspotResult,
     ScheduleResult,
     run_detector_differential,
+    run_hotspot,
     run_schedule,
 )
 
@@ -30,9 +32,11 @@ __all__ = [
     "DetectorResult",
     "FaultEvent",
     "FaultPlan",
+    "HotspotResult",
     "OracleReport",
     "check_convergence",
     "ScheduleResult",
     "run_detector_differential",
+    "run_hotspot",
     "run_schedule",
 ]

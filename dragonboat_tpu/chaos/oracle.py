@@ -110,6 +110,32 @@ def check_hashes_equal(name: str, hashes: dict) -> OracleReport:
     return rep
 
 
+def check_hot_drained(initial_leader: int, final_leader: int,
+                      transfers: list) -> OracleReport:
+    """Controller drain verdict (hotspot differential): at least one
+    ``control_transfer`` decision was flight-recorded for the hot
+    shard, every decision carries its full evidence row (the
+    observe→act loop must be auditable, not just effective), and
+    leadership actually left the initially hot replica."""
+    rep = OracleReport()
+    if not transfers:
+        rep.fail("controller planned no transfer off the hot shard")
+        return rep
+    for rec in transfers:
+        ev = rec.get("evidence") or {}
+        missing = [k for k in ("obs", "lane", "score", "lag", "streak",
+                               "term") if k not in ev]
+        if missing:
+            rep.fail(f"transfer record seq {rec.get('seq')} missing "
+                     f"evidence field(s): {', '.join(missing)}")
+    if final_leader == 0:
+        rep.fail("hot shard leaderless after the transfer window")
+    elif final_leader == initial_leader:
+        rep.fail(f"leadership never left replica {initial_leader} "
+                 "despite planned transfers")
+    return rep
+
+
 def check_invariant_probe(counters: dict) -> OracleReport:
     """The device-side invariant probe must stay silent through a whole
     chaos schedule — faults may delay commits, but no interleaving of

@@ -26,7 +26,10 @@ from dragonboat_tpu import flight
 from dragonboat_tpu.chaos.crashfs import CrashPointFS
 from dragonboat_tpu.chaos.faultplan import FaultPlan, canonical_json
 from dragonboat_tpu.chaos.oracle import (OracleReport, check_convergence,
-                                         check_invariant_probe)
+                                         check_hot_drained,
+                                         check_invariant_probe,
+                                         check_journals_equal,
+                                         check_no_acked_loss)
 from dragonboat_tpu.config import (
     Config,
     ExpertConfig,
@@ -106,11 +109,16 @@ class _Cluster:
     # extra ExpertConfig kwargs (detector differentials tune the health
     # cadence/thresholds per fault kind)
     expert_overrides: dict = field(default_factory=dict)
+    # shard ids started on every host and the workload SM they run; the
+    # hotspot differential skews proposals across two shards to heat
+    # exactly one of them
+    shards: tuple = (1,)
+    sm_cls: type = ChaosKV
     hosts: dict = field(default_factory=dict)      # rid -> NodeHost
     mems: dict = field(default_factory=dict)       # rid -> MemFS
     fss: dict = field(default_factory=dict)        # rid -> CrashPointFS
     addrs: dict = field(default_factory=dict)
-    cfgs: dict = field(default_factory=dict)       # rid -> Config
+    cfgs: dict = field(default_factory=dict)       # (rid, shard) -> Config
     epochs: dict = field(default_factory=dict)     # rid -> restart epoch
     # acked-proposal counters harvested from hosts REPLACED by a process
     # restart (a fresh NodeHost starts a fresh registry at zero); the
@@ -147,12 +155,13 @@ class _Cluster:
                                     + self._acked_counter(old))
         self.fss[rid] = CrashPointFS(self.mems[rid])
         nh = NodeHost(self._nhconfig(rid))
-        cfg = Config(shard_id=self.SHARD, replica_id=rid, election_rtt=10,
-                     heartbeat_rtt=1, snapshot_entries=0,
-                     compaction_overhead=5,
-                     device_resident=self.device_resident)
-        self.cfgs[rid] = cfg
-        nh.start_replica(dict(self.addrs), False, ChaosKV, cfg)
+        for sid in self.shards:
+            cfg = Config(shard_id=sid, replica_id=rid, election_rtt=10,
+                         heartbeat_rtt=1, snapshot_entries=0,
+                         compaction_overhead=5,
+                         device_resident=self.device_resident)
+            self.cfgs[(rid, sid)] = cfg
+            nh.start_replica(dict(self.addrs), False, self.sm_cls, cfg)
         self.hosts[rid] = nh
 
     # -- liveness --------------------------------------------------------
@@ -305,11 +314,13 @@ class _Cluster:
 
     # -- workload --------------------------------------------------------
 
-    def propose(self, cmd: bytes, timeout: float = 8.0) -> bool:
+    def propose(self, cmd: bytes, timeout: float = 8.0,
+                shard: int | None = None) -> bool:
         """Propose through any live host (host routing forwards to the
         leader); True once acked.  Duplicate commits from retried
         timeouts are fine — the oracle compares journals for equality,
         and a duplicate lands identically on every replica."""
+        sid = self.SHARD if shard is None else shard
         deadline = time.time() + timeout
         while time.time() < deadline:
             for rid in self.live_rids():
@@ -317,7 +328,7 @@ class _Cluster:
                 if nh._partitioned:
                     continue
                 try:
-                    nh.sync_propose(nh.get_noop_session(self.SHARD), cmd,
+                    nh.sync_propose(nh.get_noop_session(sid), cmd,
                                     timeout_s=1.5)
                     return True
                 except Exception:
@@ -351,12 +362,13 @@ class _Cluster:
             applied_samples.setdefault(rid, []).append(
                 (self.epochs[rid], applied))
 
-    def journals(self) -> dict:
+    def journals(self, shard: int | None = None) -> dict:
+        sid = self.SHARD if shard is None else shard
         out = {}
         for rid in self.live_rids():
             try:
                 out[rid] = list(
-                    self.hosts[rid]._node(self.SHARD).sm.sm.journal)
+                    self.hosts[rid]._node(sid).sm.sm.journal)
             except Exception:
                 continue
         return out
@@ -738,3 +750,250 @@ def run_detector_differential(seed: int, fault: str | None = None,
                           raised=raised, cleared=cleared,
                           differential_checks=diff_checks,
                           failures=failures)
+
+
+# -- hotspot differential ---------------------------------------------------
+#
+# The elastic controller (control.py) is itself under chaos test: a
+# zipfian proposal skew (HOTSPOT_SKEW:1) lands on ONE seeded-choice
+# shard whose apply path is deliberately slow.  The engine retires
+# apply outputs inside its step-timer window, so the backlog throttles
+# the whole engine round and the hosts' step-latency EWMA
+# (engine.kernel_step.ewma_us) climbs an order of magnitude — the
+# host_hot signal the controller keys on (device commit→apply lag
+# stays flow-controlled to a constant window, so lag_divergence is by
+# design NOT the observable here).  The controller on the hot leader's
+# host must flight-record a hysteresis-guarded control_transfer with
+# its evidence row and leadership must actually leave the initially
+# hot replica, all with zero acked-write loss across the handoff.
+
+#: hot:cold proposals per pump round (the "100:1 onto one host" skew)
+HOTSPOT_SKEW = 100
+#: per-entry apply cost of HotspotKV — enough to inflate the engine
+#: round well past HOTSPOT_HOT_EWMA_US under the skew, small enough
+#: that the capped backlog drains well inside the convergence window
+HOTSPOT_APPLY_DELAY_S = 0.01
+#: host-hot threshold for the run: idle CPU steps measure ~10-15 ms,
+#: the pump pushes the EWMA to ~90 ms, so 30 ms separates cleanly in
+#: both directions
+HOTSPOT_HOT_EWMA_US = 30_000
+#: pump backpressure: stop firing once this many proposals are
+#: unresolved — bounds the post-drain apply time (cap * delay) without
+#: capping the overload signal (the EWMA saturates long before this)
+HOTSPOT_MAX_PENDING = 800
+
+
+class HotspotKV(ChaosKV):
+    """ChaosKV with a deliberately slow apply path: under skewed load
+    the apply backlog backpressures the engine round, inflating the
+    step-latency EWMA the controller's host_hot gate reads."""
+
+    def update(self, entry):
+        time.sleep(HOTSPOT_APPLY_DELAY_S)
+        return super().update(entry)
+
+
+@dataclass
+class HotspotResult:
+    seed: int
+    hot_shard: int
+    cold_shard: int
+    initial_leader: int       # replica leading the hot shard at pump start
+    final_leader: int         # replica leading it after the drain
+    transfers: list           # control_transfer flight records (hot shard)
+    acked_count: int
+    report: OracleReport
+
+    @property
+    def ok(self) -> bool:
+        return self.report.ok
+
+
+def run_hotspot(seed: int, n_replicas: int = 3,
+                transfer_window: float = 30.0,
+                converge_timeout: float = 45.0) -> HotspotResult:
+    """Drive the zipfian skew onto one device-resident shard and check
+    the observe→act loop end to end: the controller drains the hot
+    host within the window (check_hot_drained), every acked write
+    survives the handoff (check_no_acked_loss + journal equality per
+    shard), the leaderless gauge returns to zero, and the runtime
+    invariant probe stayed silent throughout."""
+    rng = Random(seed)
+    shards = (1, 2)
+    hot = rng.choice(shards)
+    cold = shards[0] if hot == shards[1] else shards[1]
+    overrides = dict(
+        # fast decimated observations; two consecutive hot observations
+        # satisfy the hysteresis; the step-latency EWMA is the hot
+        # signal (see the section comment)
+        fleet_stats_every=5,
+        control_enabled=True, control_hysteresis=2,
+        control_cooldown_obs=8, control_max_transfers=1,
+        control_seed=seed, control_hot_ewma_us=HOTSPOT_HOT_EWMA_US)
+    cluster = _Cluster(seed=seed, n=n_replicas, device_resident=True,
+                       expert_overrides=overrides, shards=shards,
+                       sm_cls=HotspotKV)
+    report = OracleReport()
+    transfers: list = []
+    pending: list = []        # (shard, cmd, RequestState) fired async
+    initial_leader = 0
+    final_leader = 0
+    acked: dict = {hot: [], cold: []}
+
+    def wait_leader(sid: int, timeout: float) -> int:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for rid in cluster.live_rids():
+                try:
+                    lid, ok = cluster.hosts[rid].get_leader_id(sid)
+                except Exception:
+                    continue
+                if ok and lid:
+                    return lid
+            time.sleep(0.05)
+        return 0
+
+    def fire(sid: int, cmd: bytes) -> None:
+        # async propose: the futures are harvested after the pump stops.
+        # The backlog IS the fault — a sync ack per proposal would
+        # throttle the skew down to the apply rate and no lag would
+        # ever build
+        rids = cluster.live_rids()
+        nh = cluster.hosts[rids[len(pending) % len(rids)]]
+        try:
+            rs = nh.propose(nh.get_noop_session(sid), cmd, timeout_s=15.0)
+        except Exception:
+            return            # book full / not ready: a drop, not an ack
+        pending.append((sid, cmd, rs))
+
+    def unresolved() -> int:
+        return sum(1 for _, _, rs in pending if not rs._event.is_set())
+
+    def max_ewma() -> int:
+        return max((int(cluster.hosts[rid].events.metrics.snapshot()
+                        .get("engine.kernel_step.ewma_us", 0))
+                    for rid in cluster.live_rids()), default=0)
+
+    try:
+        cluster.start()
+        # settle both shards (the first device-resident cluster in a
+        # process pays the kernel jit compile inside this window)
+        for sid in shards:
+            if not cluster.propose(f"genesis{sid}=1".encode(),
+                                   timeout=45.0, shard=sid):
+                report.fail(f"shard {sid}: no initial commit — cluster "
+                            "never settled")
+        # compile warmup: the first steps carry the jit cost, so every
+        # host's EWMA starts far above the threshold.  The policy's
+        # warmup_obs suppresses controller action on that noise; the
+        # harness additionally waits for the decay so the baseline
+        # leader is read from a quiet fleet and start_seq excludes any
+        # residual warmup decisions
+        deadline = time.time() + 60.0
+        while max_ewma() >= HOTSPOT_HOT_EWMA_US and time.time() < deadline:
+            time.sleep(0.25)
+        if max_ewma() >= HOTSPOT_HOT_EWMA_US:
+            report.fail("engines never settled below the hot threshold "
+                        "after compile warmup")
+        initial_leader = wait_leader(hot, 10.0)
+        if not initial_leader:
+            report.fail("no leader on the hot shard before the pump")
+        start_seq = flight.RECORDER.next_seq
+        deadline = time.time() + transfer_window
+        i = 0
+        while time.time() < deadline and not transfers:
+            if unresolved() < HOTSPOT_MAX_PENDING:
+                batch = [hot] * HOTSPOT_SKEW + [cold]
+                rng.shuffle(batch)
+                for sid in batch:
+                    fire(sid, f"h{sid}i{i}=v{seed}".encode())
+                    i += 1
+            transfers = [
+                r for r in flight.RECORDER.tail()
+                if r["seq"] >= start_seq
+                and r["kind"] == flight.CONTROL_TRANSFER
+                and r.get("shard_id") == hot]
+            # let the apply backlog shape the next health digest before
+            # re-scanning (the scan itself is cheap; the controller acts
+            # on decimated ticks, not on our polling cadence)
+            time.sleep(0.05)
+        # bounded drain: leadership must actually leave the hot replica
+        if transfers:
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                lid = wait_leader(hot, 5.0)
+                if lid and lid != initial_leader:
+                    final_leader = lid
+                    break
+                time.sleep(0.05)
+            if not final_leader:
+                final_leader = wait_leader(hot, 1.0)
+        report.merge(check_hot_drained(initial_leader, final_leader,
+                                       transfers))
+        # pump stopped: resolve the outstanding futures (the backlog
+        # drains at the slow-apply rate), then the completed ones are
+        # exactly the acked set the loss oracle holds the fleet to
+        deadline = time.time() + converge_timeout
+        while unresolved() and time.time() < deadline:
+            time.sleep(0.1)
+        if unresolved():
+            report.fail(f"{unresolved()} proposals still unresolved "
+                        "after the drain window")
+        for sid, cmd, rs in pending:
+            if rs.wait(0).completed():
+                acked[sid].append(cmd)
+        # post-drain liveness: the fleet still commits on both shards
+        # under the new leadership, and the marker doubles as the
+        # convergence fence for the journal comparison
+        markers = {}
+        for sid in shards:
+            markers[sid] = f"drained{sid}x{seed}=1".encode()
+            if not cluster.propose(markers[sid], timeout=15.0, shard=sid):
+                report.fail(f"shard {sid}: post-drain proposal never "
+                            "acked")
+        deadline = time.time() + converge_timeout
+        converged = False
+        while time.time() < deadline and not converged:
+            converged = True
+            for sid in shards:
+                js = cluster.journals(shard=sid)
+                vals = list(js.values())
+                if (len(js) != cluster.n
+                        or any(v != vals[0] for v in vals[1:])
+                        or markers[sid] not in vals[0]):
+                    converged = False
+                    break
+            if not converged:
+                time.sleep(0.1)
+        if not converged:
+            report.fail("cluster did not converge after the drain")
+        for sid in shards:
+            js = cluster.journals(shard=sid)
+            report.merge(check_journals_equal(js))
+            report.merge(check_no_acked_loss(acked[sid], js))
+        # the leaderless gauge returns to zero once converged —
+        # event-driven on the flight recorder, as in run_schedule
+        if converged:
+            deadline = time.time() + 5.0
+            seq = flight.RECORDER.next_seq
+            leaderless = cluster.leaderless_total()
+            while leaderless and time.time() < deadline:
+                flight.RECORDER.wait_beyond(
+                    seq, timeout=min(0.5, max(0.0,
+                                              deadline - time.time())))
+                seq = flight.RECORDER.next_seq
+                leaderless = cluster.leaderless_total()
+            if leaderless:
+                report.fail(f"health.leaderless_now gauge stuck at "
+                            f"{leaderless} after the drain")
+        report.invariant_probe = cluster.invariant_counters()
+        report.merge(check_invariant_probe(report.invariant_probe))
+        if not report.ok:
+            report.flight_tail = flight.RECORDER.tail(64)
+    finally:
+        cluster.close()
+    return HotspotResult(
+        seed=seed, hot_shard=hot, cold_shard=cold,
+        initial_leader=initial_leader, final_leader=final_leader,
+        transfers=transfers, acked_count=sum(map(len, acked.values())),
+        report=report)

@@ -198,6 +198,34 @@ class ExpertConfig:
     # backend reports none, e.g. CPU)
     capacity_watermark_pct: float = 10.0
     capacity_device_budget_bytes: int = 0
+    # elastic fleet controller (control.py): when enabled, each
+    # decimated health observation may plan hysteresis-guarded,
+    # rate-limited leader transfers off this host; decisions are a pure
+    # function of digest contents + control_seed (flight-recorded as
+    # control_transfer with evidence)
+    control_enabled: bool = False
+    control_hot_score: int = 8
+    control_lag_hot: int = 64
+    control_hysteresis: int = 2
+    control_cooldown_obs: int = 8
+    control_max_transfers: int = 2
+    control_seed: int = 0
+    # observations during which the host-hot latency input is ignored
+    # (jit compile inflates the step EWMA at process start)
+    control_warmup_obs: int = 8
+    # host-hot gate for the controller: engine step-latency EWMA
+    # (engine.kernel_step.ewma_us — the measure() window includes
+    # output retirement, so apply backpressure shows up here) above
+    # this marks every led shard a drain candidate; 0 disables the
+    # latency input
+    control_hot_ewma_us: int = 0
+    # capacity-driven admission (control.check_admission): StartReplica
+    # of a device-resident shard past the derated max_g_for_budget
+    # watermark is refused ("enforce"), recorded only ("warn"), or
+    # ungated ("off").  Needs a resolvable device budget
+    # (capacity_device_budget_bytes or backend-reported bytes_limit) —
+    # capacity unknown never refuses
+    admission_policy: str = "off"
     # opt into the persistent JAX compilation cache at host startup
     # (hostenv.enable_compile_cache; DRAGONBOAT_TPU_COMPILE_CACHE=0
     # vetoes).  Off by default: the cache dir is process-global state

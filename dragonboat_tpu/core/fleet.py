@@ -47,6 +47,7 @@ CONTRACTS = {
         "role_count": "[ROLES] i32 part=replicated collective=declared",
         "leaderless": "[] i32 part=replicated collective=declared",
         "election_active": "[] i32 part=replicated collective=declared",
+        "quiesced": "[] i32 part=replicated collective=declared",
         "term_max": "[] i32 part=replicated collective=declared",
         "term_min": "[] i32 part=replicated collective=declared",
         "lag_hist": "[LAGB] i32 part=replicated collective=declared",
@@ -66,6 +67,7 @@ class FleetStats(NamedTuple):
     role_count: jnp.ndarray       # [NUM_ROLES]
     leaderless: jnp.ndarray       # [] — occupied lanes with no known leader
     election_active: jnp.ndarray  # [] — candidates + pre-vote candidates
+    quiesced: jnp.ndarray         # [] — occupied lanes masked-quiesced
     term_max: jnp.ndarray         # [] (0 when no lane is occupied)
     term_min: jnp.ndarray         # [] (0 when no lane is occupied)
     lag_hist: jnp.ndarray         # [len(LAG_BUCKETS)+1] cumulative counts
@@ -85,6 +87,7 @@ def _fleet_stats_impl(state, inbox_from) -> FleetStats:
     election_active = (occ & ((state.role == P.CANDIDATE)
                               | (state.role == P.PRE_VOTE_CANDIDATE))
                        ).astype(i32).sum()
+    quiesced = (occ & state.quiesced).astype(i32).sum()
     big = jnp.iinfo(jnp.int32).max
     term_max = jnp.where(occ, state.term, 0).max()
     term_min = jnp.where(occupied > 0,
@@ -101,7 +104,8 @@ def _fleet_stats_impl(state, inbox_from) -> FleetStats:
     inbox_hist = jnp.concatenate([inbox_le, occupied[None]])
     return FleetStats(
         occupied=occupied, role_count=role_count, leaderless=leaderless,
-        election_active=election_active, term_max=term_max,
+        election_active=election_active, quiesced=quiesced,
+        term_max=term_max,
         term_min=term_min, lag_hist=lag_hist, inbox_hist=inbox_hist)
 
 
@@ -120,6 +124,7 @@ def stats_to_dict(stats: FleetStats) -> dict:
                        for i in range(NUM_ROLES)},
         "leaderless": int(s.leaderless),
         "election_active": int(s.election_active),
+        "quiesced": int(s.quiesced),
         "term_max": int(s.term_max),
         "term_min": int(s.term_min),
         "lag_hist": {lab: int(s.lag_hist[i])
@@ -136,6 +141,7 @@ def empty_dict() -> dict:
         "role_count": {r: 0 for r in ROLE_NAMES},
         "leaderless": 0,
         "election_active": 0,
+        "quiesced": 0,
         "term_max": 0,
         "term_min": 0,
         "lag_hist": {lab: 0 for lab in bucket_labels(LAG_BUCKETS)},
@@ -149,6 +155,7 @@ def merge_into(base: dict, other: dict) -> None:
     base["occupied"] += other["occupied"]
     base["leaderless"] += other["leaderless"]
     base["election_active"] += other["election_active"]
+    base["quiesced"] += other.get("quiesced", 0)
     base["term_max"] = max(base["term_max"], other["term_max"])
     mins = [m for m in (base["term_min"], other["term_min"]) if m > 0]
     base["term_min"] = min(mins) if mins else 0
@@ -161,7 +168,7 @@ def merge_into(base: dict, other: dict) -> None:
 
 
 def add_host_shard(base: dict, role: str, leaderless: bool, term: int,
-                   lag: int) -> None:
+                   lag: int, quiesced: bool = False) -> None:
     """Fold one HOST-resident (non-kernel) replica into a fleet dict —
     host clusters have no device state to reduce, but the /metrics
     surface must still answer role/leaderless/lag questions."""
@@ -172,6 +179,8 @@ def add_host_shard(base: dict, role: str, leaderless: bool, term: int,
         base["leaderless"] += 1
     if role in ("candidate", "pre_vote_candidate"):
         base["election_active"] += 1
+    if quiesced:
+        base["quiesced"] += 1
     if term > 0:
         base["term_max"] = max(base["term_max"], term)
         base["term_min"] = (term if base["term_min"] == 0
@@ -216,6 +225,9 @@ def register_exposition(registry, source, replace: bool = False) -> None:
     registry.gauge_fn("fleet.election_active",
                       lambda: _get()["election_active"],
                       help="shards currently campaigning")
+    registry.gauge_fn("fleet.quiesced_shards",
+                      lambda: _get().get("quiesced", 0),
+                      help="occupied shards in masked quiesce")
     registry.gauge_fn("fleet.term_max", lambda: _get()["term_max"],
                       help="max raft term over occupied shards")
     registry.gauge_fn("fleet.term_min", lambda: _get()["term_min"],

@@ -47,7 +47,8 @@ INT32_MAX = 2**31 - 1
 #: every ``prev.`` term any declared invariant references (checked below
 #: at import, so adding an invariant with a new prev. field fails loudly
 #: until the digest + CONTRACTS grow the column)
-_PREV_FIELDS = ("term", "vote", "committed", "role")
+_PREV_FIELDS = ("term", "vote", "committed", "role", "quiesced",
+                "quiesce_epoch")
 
 _needed = {t.name
            for inv in PARSED.values()
@@ -72,6 +73,8 @@ CONTRACTS = {
         "prev_vote": "[G] i32 part=G",
         "prev_committed": "[G] i32 part=G",
         "prev_role": "[G] i32 part=G",
+        "prev_quiesced": "[G] i32 part=G",
+        "prev_quiesce_epoch": "[G] i32 part=G",
         "ticks": "[G] i32 part=G",
     },
     "InvariantReport": {
@@ -91,6 +94,8 @@ class InvariantDigest(NamedTuple):
     prev_vote: jnp.ndarray       # [G]
     prev_committed: jnp.ndarray  # [G]
     prev_role: jnp.ndarray       # [G]
+    prev_quiesced: jnp.ndarray   # [G] (bool state column widened to i32)
+    prev_quiesce_epoch: jnp.ndarray  # [G]
     ticks: jnp.ndarray           # [G] digest age (0 = no valid prev)
 
 
@@ -193,6 +198,8 @@ def _check_invariants_impl(state, inv_digest: InvariantDigest
     new_digest = InvariantDigest(
         prev_term=state.term, prev_vote=state.vote,
         prev_committed=state.committed, prev_role=state.role,
+        prev_quiesced=state.quiesced.astype(i32),
+        prev_quiesce_epoch=state.quiesce_epoch,
         ticks=inv_digest.ticks + 1)
     return report, new_digest
 

@@ -377,7 +377,15 @@ def _become_leader(kp, s: ShardState, mask, eff: Effects):
 def _campaign(kp, s: ShardState, eff: Effects, mask, allow_prevote=True):
     """Election entry — handleNodeElection (raft.go:1632): pre-vote campaign
     unless transferring; single-node fast paths to leader."""
-    gate = s.committed > s.applied  # conservative config-change gate
+    # config-change gate (raft.go:1632 handleNodeElection): refuse to
+    # campaign only when a CONFIG CHANGE sits committed-but-unapplied —
+    # voting safety is log-based, so plain unapplied entries don't
+    # matter.  Gating on committed > applied alone is a liveness trap:
+    # apply backpressure keeps the window permanently non-empty on a
+    # busy host, making elections (and TimeoutNow transfers) impossible
+    # exactly when load needs to move
+    gate = (s.committed > s.applied) & (
+        _cc_count_in(kp, s, s.applied, s.committed) > 0)
     mask = mask & ~gate & ~_self_removed(s)
     use_prevote = s.pre_vote & ~s.is_ltt & allow_prevote
     single = _is_single_node(s)
@@ -882,6 +890,25 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
     # 0. host-confirmed applied cursor
     s = s._replace(applied=jnp.maximum(s.applied, inp.applied))
 
+    # 0b. device quiesce wake (quiesce.go:60-77 record): any non-heartbeat
+    # inbound message or client activity (proposal, read, transfer) wakes
+    # the lane, resets its idle clock and bumps the wake epoch the quiesce
+    # invariants key on.  Heartbeats never count as activity: while awake
+    # they must not defer quiesce entry (quiesce.go:64), and while
+    # masked-quiesced the handlers below still process them, so —
+    # divergence from the reference's grace-window wake — no wake is
+    # needed for state parity.  e_tick resets so a lane whose election
+    # clock banked up across quiesced ticks cannot campaign the instant
+    # it wakes.
+    hb_like = (box.mtype == MT.HEARTBEAT) | (box.mtype == MT.HEARTBEAT_RESP)
+    activity = (
+        jnp.any((box.from_ != 0) & ~hb_like)
+        | jnp.any(inp.prop_valid) | inp.ri_valid | (inp.transfer_to != 0)
+    )
+    wake = s.quiesced & activity
+    s = mrep(s, wake, quiesced=False, idle_tick=0, e_tick=0,
+             quiesce_epoch=s.quiesce_epoch + 1)
+
     # 1. inbox processing — slots grouped by their static family
     # (params.slot_families): each family's scan body compiles ONLY that
     # family's handlers, cutting the serial full-matrix cost by ~4x on
@@ -1032,9 +1059,12 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
 
     # 5. tick (raft.go:571-655)
     is_leader = s.role == P.LEADER  # refresh (campaigns can't happen above)
-    live_tick = inp.tick & ~inp.quiesced
+    # the quiesced mask is the union of the host-driven input flag and
+    # the device-resident mask (post-wake, so an activity step ticks live)
+    q_any = inp.quiesced | s.quiesced
+    live_tick = inp.tick & ~q_any
     # quiesced tick: just advance the election clock
-    s = mrep(s, inp.tick & inp.quiesced, e_tick=s.e_tick + 1)
+    s = mrep(s, inp.tick & q_any, e_tick=s.e_tick + 1)
     # non-leader tick
     nl = live_tick & ~is_leader
     s = mrep(s, nl, e_tick=s.e_tick + 1)
@@ -1076,6 +1106,20 @@ def _shard_step(kp: P.KernelParams, s: ShardState, box, inp):
         hb_high=sel(hb_time, sel(has_pending, _get1(kp, s.ri_high, newest), 0),
                     eff.hb_high),
     )
+
+    # 5b. device quiesce idle clock + entry (quiesce.go:43-54 tick): an
+    # enabled, awake lane idle for e_timeout*10 ticks (quiesce.py
+    # threshold) raises its quiesced mask; entry clears both protocol
+    # clocks so neither an election nor a heartbeat fires mid-quiesce.
+    # Entry is evaluated AFTER this step's tick work, so the step that
+    # crosses the threshold still ran live — the mask only gates future
+    # steps, and the kernel stays bitwise-identical with quiesce_on off.
+    s = mrep(s, inp.tick & ~activity & ~s.quiesced,
+             idle_tick=s.idle_tick + 1)
+    s = mrep(s, activity, idle_tick=0)
+    enter_q = (s.quiesce_on & ~s.quiesced & inp.tick
+               & (s.idle_tick >= s.e_timeout * 10))
+    s = mrep(s, enter_q, quiesced=True, e_tick=0, h_tick=0)
 
     # 6. send phase ------------------------------------------------------
     is_leader = s.role == P.LEADER

@@ -111,6 +111,11 @@ CONTRACTS = {
         "ri_head": "[G] i32 part=G",
         "ri_count": "[G] i32 part=G",
         "needs_host": "[G] bool part=G",
+        # device quiesce (the kernel-masked form of quiesce.py)
+        "quiesce_on": "[G] bool part=G",
+        "idle_tick": "[G] i32 part=G",
+        "quiesced": "[G] bool part=G",
+        "quiesce_epoch": "[G] i32 part=G",
         "lv": "[G, CAP] i32 ring optional part=G",
     },
     "Inbox": {
@@ -238,6 +243,18 @@ INVARIANTS = {
     "leader_commit_quorum":
         "role == LEADER & prev.role == LEADER & term == prev.term"
         " & committed > prev.committed => quorum(match) >= committed",
+    # a quiesced replica never campaigns (no term movement) or grants
+    # votes.  quiesce_epoch bumps on every wake, so an unchanged epoch
+    # between two observations proves the lane stayed quiesced for the
+    # WHOLE interval — making both forms sound at any probe decimation
+    # (a wake + re-quiesce between observations changes the epoch and
+    # the guard fails vacuously)
+    "quiesced_no_campaign":
+        "prev.quiesced == 1 & quiesced == 1"
+        " & quiesce_epoch == prev.quiesce_epoch => term == prev.term",
+    "quiesced_no_vote":
+        "prev.quiesced == 1 & quiesced == 1"
+        " & quiesce_epoch == prev.quiesce_epoch => vote == prev.vote",
 }
 
 
@@ -356,6 +373,16 @@ class ShardState(NamedTuple):
     # (e.g. a peer needs an InstallSnapshot stream) — host must intervene
     needs_host: jnp.ndarray     # [G] bool
 
+    # device quiesce (quiesce.go state machine folded into the step):
+    # an enabled lane idle for e_timeout*10 ticks raises its quiesced
+    # mask and stops taking live ticks (no elections, no heartbeats);
+    # any non-heartbeat inbox or client activity wakes it and bumps
+    # quiesce_epoch (the wake counter the quiesce invariants key on)
+    quiesce_on: jnp.ndarray     # [G] bool — per-lane enable (Config.quiesce)
+    idle_tick: jnp.ndarray      # [G] i32 — ticks since last activity
+    quiesced: jnp.ndarray       # [G] bool — device-resident quiesced mask
+    quiesce_epoch: jnp.ndarray  # [G] i32 — wakes so far (monotone)
+
     # inline payload slot ring [G, CAP] i32 (SURVEY §7: small fixed-width
     # values on device; bigger payloads stay host-side keyed by index).
     # None unless kp.inline_payloads — the plain path carries no ring.
@@ -373,6 +400,7 @@ def init_state(
     check_quorum: bool = False,
     pre_vote: bool = False,
     seeds=None,
+    quiesce: bool = False,
 ) -> ShardState:
     """Build a fresh [G] state.
 
@@ -462,6 +490,10 @@ def init_state(
         ri_head=jnp.asarray(z()),
         ri_count=jnp.asarray(z()),
         needs_host=jnp.asarray(zb()),
+        quiesce_on=jnp.full((G,), quiesce, bool),
+        idle_tick=jnp.asarray(z()),
+        quiesced=jnp.asarray(zb()),
+        quiesce_epoch=jnp.asarray(z()),
     )
 
 

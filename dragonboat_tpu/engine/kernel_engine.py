@@ -553,7 +553,8 @@ class KernelEngine:
             "replica_id", "seed", "rand_timeout", "e_timeout", "h_timeout",
             "role", "term", "vote", "applied", "snap_index", "snap_term",
             "last", "committed")}
-        fb = {k: np.zeros((n,), bool) for k in ("check_quorum", "pre_vote")}
+        fb = {k: np.zeros((n,), bool) for k in ("check_quorum", "pre_vote",
+                                                "quiesce_on")}
         pid_rows = np.zeros((n, kp.num_peers), np.int32)
         kind_rows = np.zeros((n, kp.num_peers), np.int32)
         lt_rows = np.zeros((n, kp.log_cap), np.int32)
@@ -588,6 +589,7 @@ class KernelEngine:
             f32["h_timeout"][j] = max(1, cfg.heartbeat_rtt)
             fb["check_quorum"][j] = cfg.check_quorum
             fb["pre_vote"][j] = cfg.pre_vote
+            fb["quiesce_on"][j] = cfg.quiesce
             f32["role"][j] = role
             f32["term"][j] = init.term
             f32["vote"][j] = init.vote
@@ -652,6 +654,10 @@ class KernelEngine:
                 ri_head=put(s.ri_head, 0),
                 ri_count=put(s.ri_count, 0),
                 needs_host=put(s.needs_host, False),
+                quiesce_on=put(s.quiesce_on, A["quiesce_on"]),
+                idle_tick=put(s.idle_tick, 0),
+                quiesced=put(s.quiesced, False),
+                quiesce_epoch=put(s.quiesce_epoch, 0),
             )
 
     def _clear_lane(self, lane: int) -> None:
@@ -669,6 +675,9 @@ class KernelEngine:
             kind=s.kind.at[lane].set(KP.K_ABSENT),
             pid=s.pid.at[lane].set(0),
             needs_host=s.needs_host.at[lane].set(False),
+            # a vacated lane must not linger in the fleet quiesced count
+            quiesce_on=s.quiesce_on.at[lane].set(False),
+            quiesced=s.quiesced.at[lane].set(False),
         )
         self._kind_np[lane] = KP.K_ABSENT
         self._pid_np[lane] = 0
@@ -1168,6 +1177,18 @@ class KernelEngine:
             compact_key, n.compaction_request_key = (
                 n.compaction_request_key, None)
             ticks, n._tick_pending = n._tick_pending, 0
+            # sticky transfer lease: the kernel aborts an armed transfer
+            # at its next check-quorum round (core/kernel.py abort_tr),
+            # which under apply backpressure fires before the transferee
+            # can catch up — a one-shot staging then loses the request
+            # forever.  Re-arm every step while the transfer future is
+            # live; the re-arm is a no-op while ltt is set, and the book
+            # timeout (pending_transfer.gc) bounds the lease
+            if transfer is None and n._transfer_awaiting is not None:
+                if n.pending_transfer.outstanding is not None:
+                    transfer = n._transfer_awaiting[0]
+                else:
+                    n._transfer_awaiting = None    # timed out: lease over
 
         # an InstallSnapshot forces eviction — restore everything drained
         # so the successor Node inherits it intact
@@ -1268,6 +1289,7 @@ class KernelEngine:
                             or n._remote_reads
                             or n.config_change_entry is not None
                             or n.transfer_target is not None
+                            or n._transfer_awaiting is not None
                             or n.snapshot_request is not None
                             or n.log_query_range is not None
                             or n.compaction_request_key is not None

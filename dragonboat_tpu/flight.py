@@ -33,6 +33,8 @@ ANOMALY_CLEARED = "anomaly_cleared"
 RETRACE_STORM = "retrace_storm"
 MEMORY_PRESSURE = "memory_pressure"
 INVARIANT_VIOLATION = "invariant_violation"
+CONTROL_TRANSFER = "control_transfer"
+ADMISSION_REFUSED = "admission_refused"
 
 
 class FlightRecorder:

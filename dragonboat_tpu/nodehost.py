@@ -53,6 +53,21 @@ class ShardNotFoundError(RequestError):
     pass
 
 
+class AdmissionRefusedError(RequestError):
+    """StartReplica refused by the capacity admission controller
+    (control.check_admission): the host is at or past its derated
+    device-capacity watermark.  Carries the evidence row so callers can
+    act on it (retry elsewhere, raise the budget, relax the policy)."""
+
+    def __init__(self, shard_id: int, evidence: dict) -> None:
+        super().__init__(
+            f"shard {shard_id}: device admission refused "
+            f"(occupied {evidence.get('occupied')} >= "
+            f"limit {evidence.get('limit')})")
+        self.shard_id = shard_id
+        self.evidence = dict(evidence)
+
+
 @dataclass
 class ShardInfo:
     shard_id: int
@@ -231,6 +246,23 @@ class NodeHost:
         # the shared multi-chip engine, attached on the first
         # mesh-resident shard (engine/mesh_engine.py)
         self.mesh_engine = None
+        # elastic fleet controller (control.py): consumes each decimated
+        # health observation on the engine ticker thread (_control_round)
+        # and plans rate-limited, hysteresis-guarded leader transfers off
+        # this host.  Single-owner state: only the ticker touches it
+        from dragonboat_tpu import control as _control
+
+        _ex = nhconfig.expert
+        self._controller = _control.FleetController(_control.ControlPolicy(
+            enabled=_ex.control_enabled,
+            hot_score=_ex.control_hot_score,
+            lag_hot=_ex.control_lag_hot,
+            hysteresis=_ex.control_hysteresis,
+            cooldown_obs=_ex.control_cooldown_obs,
+            max_transfers=_ex.control_max_transfers,
+            seed=_ex.control_seed,
+            warmup_obs=_ex.control_warmup_obs))
+        self._ctrl_seen_seq = 0   # engine health observations consumed
         # partitioned step workers (engine.go:1107 workerPool: shards hash
         # onto fixed workers so each node is stepped by exactly one
         # thread; the sharded LogDB gives each partition its own active
@@ -603,6 +635,7 @@ class NodeHost:
         """StartReplica (nodehost.go:499) for a regular/concurrent SM
         factory ``create_sm(shard_id, replica_id)``."""
         cfg.validate()
+        self._admit_replica(cfg)
         with self.mu:
             if cfg.shard_id in self.nodes:
                 raise RequestError("shard already started")
@@ -681,6 +714,54 @@ class NodeHost:
         self.events.node_unloaded(NodeInfo(shard_id, node.replica_id))
 
     # -- kernel engine glue ----------------------------------------------
+
+    def _admit_replica(self, cfg: Config) -> None:
+        """Capacity-driven admission (control.check_admission): a
+        device-resident StartReplica past the derated capacity watermark
+        is refused under policy "enforce", recorded-but-admitted under
+        "warn".  The limit is max_g_for_budget over the explicit device
+        budget (else the backend-reported bytes_limit) derated by the
+        headroom watermark; with no resolvable budget the gate never
+        refuses — capacity unknown is not capacity exhausted."""
+        from dragonboat_tpu import capacity as _capacity
+        from dragonboat_tpu import control as _control
+        from dragonboat_tpu import flight as _flight
+
+        ex = self.config.expert
+        mode = ex.admission_policy
+        if mode not in (_control.ADMISSION_ENFORCE, _control.ADMISSION_WARN):
+            return
+        mesh = (cfg.mesh_resident and not cfg.is_witness
+                and ex.mesh is not None)
+        if not (cfg.device_resident and not cfg.is_witness and not mesh):
+            return
+        self.events.metrics.inc("control_admission_total")
+        budget = ex.capacity_device_budget_bytes
+        if budget <= 0:
+            budget = max((r["bytes_limit"]
+                          for r in _capacity.device_memory_stats()),
+                         default=0)
+        limit = _control.admission_limit(
+            self._kernel_params(), budget, ex.capacity_watermark_pct,
+            _capacity.max_g_for_budget)
+        with self.mu:
+            occupied = sum(
+                1 for n in self.nodes.values()
+                if getattr(n, "engine", None) is not None
+                and getattr(n, "lane", -1) >= 0)
+        d = _control.check_admission(cfg.shard_id, occupied, limit,
+                                     mode=mode)
+        if d is None:
+            return
+        self.events.metrics.inc("control_admission_refused")
+        _flight.record(_flight.ADMISSION_REFUSED,
+                       tick=self._tick_round_no, shard_id=d.shard_id,
+                       mode=mode, evidence=d.evidence)
+        if mode == _control.ADMISSION_ENFORCE:
+            raise AdmissionRefusedError(cfg.shard_id, d.evidence)
+        _LOG.warning("shard %d: admission watermark exceeded (%s) — "
+                     "admitted under policy 'warn'",
+                     cfg.shard_id, d.evidence)
 
     def _inject_kernel_shard(self, node, members: dict[int, str]) -> None:
         """Move a freshly-bootstrapped shard onto the device kernel: the
@@ -1006,11 +1087,69 @@ class NodeHost:
         for eng in (self.kernel_engine, self.mesh_engine):
             if eng is not None:
                 eng.tick_round()
+        self._control_round()
 
     def tick_all(self) -> None:
         """Manual tick for auto_run=False test drivers (books GC every
         round — deterministic timeouts for tests)."""
         self._do_tick_round(sweep_every=1)
+
+    def _control_round(self) -> None:
+        """Close the observe→act loop once per NEW decimated health
+        observation: feed the kernel engine's cached top-K digest (plus
+        the step-latency EWMA) to the FleetController and apply the
+        planned transfers.  Runs on the engine ticker thread, outside
+        engine.mu (lock order engine.mu -> node.mu: the transfer call
+        takes node locks, so it must never run under the engine's)."""
+        eng = self.kernel_engine
+        if eng is None or not self._controller.policy.enabled:
+            return
+        seq = int(getattr(eng, "_health_seq", 0))
+        if seq <= self._ctrl_seen_seq:
+            return            # no new observation since the last plan
+        self._ctrl_seen_seq = seq
+        health = getattr(eng, "last_health", None) or {}
+        worst = health.get("worst", [])
+        lanes = {int(w.get("lane", -1)) for w in worst}
+        hot_us = self.config.expert.control_hot_ewma_us
+        host_hot = bool(hot_us) and int(self.events.metrics.snapshot().get(
+            "engine.kernel_step.ewma_us", 0)) >= hot_us
+        # digest offenders are the candidate set — except under host-
+        # level overload, where every led shard qualifies (the planner's
+        # host_hot semantics), so the snapshot must include them all
+        with self.mu:
+            nodes = [n for n in self.nodes.values()
+                     if getattr(n, "engine", None) is eng
+                     and (host_hot or getattr(n, "lane", -1) in lanes)]
+        shards = []
+        for n in nodes:
+            try:
+                mb = n.sm.get_membership()
+                shards.append({
+                    "shard_id": int(n.shard_id),
+                    "replica_id": int(n.replica_id),
+                    "lane": int(n.lane),
+                    "is_leader": bool(n.is_leader()),
+                    "term": int(n.node_term()),
+                    "membership": {"addresses": {
+                        int(r): str(a) for r, a in mb.addresses.items()}},
+                })
+            except Exception:
+                continue      # torn down mid-plan: skip this round's row
+        from dragonboat_tpu import flight as _flight
+
+        for d in self._controller.observe(worst, shards,
+                                          host_hot=host_hot):
+            _flight.record(_flight.CONTROL_TRANSFER,
+                           tick=self._tick_round_no, shard_id=d.shard_id,
+                           target=d.target, evidence=d.evidence)
+            try:
+                self.request_leader_transfer(d.shard_id, d.target)
+                self.events.metrics.inc("control_transfer_issued")
+            except RequestError as e:
+                self.events.metrics.inc("control_transfer_failed")
+                _LOG.warning("control transfer shard %d -> %d failed: %s",
+                             d.shard_id, d.target, e)
 
     def _stream_snapshot(self, node: Node, m: pb.Message) -> None:
         """Live-stream an on-disk SM's snapshot to a lagging peer
@@ -1565,12 +1704,14 @@ class NodeHost:
                 "last_applied": int(si.last_applied),
                 "membership": self._membership_dict(si.membership),
                 "resident": self._residency(n),
+                "lane": int(getattr(n, "lane", -1)),
             })
         return {
             "node_host_id": nhi.node_host_id,
             "raft_address": nhi.raft_address,
             "health": self._health_snapshot(),
             "capacity": self._capacity_snapshot(),
+            "fleet": self._fleet_snapshot(),
             "shards": shards,
         }
 

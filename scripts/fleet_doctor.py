@@ -12,12 +12,25 @@ residency/leader summary.
     python scripts/fleet_doctor.py 127.0.0.1:9090 --json
     python scripts/fleet_doctor.py 127.0.0.1:9090 --shard 7
     python scripts/fleet_doctor.py 127.0.0.1:9090 --shard 7 --json
+    python scripts/fleet_doctor.py 127.0.0.1:9090 --plan
+    python scripts/fleet_doctor.py 127.0.0.1:9090 --plan --json
 
 ``--shard N`` drills into ``/debug/group/N`` (NodeHost.shard_info():
 the one group's O(1) device row merged with host registers — pending
 books, logdb range, breaker states, gossip ShardView).  ``--json``
 prints the validated payload verbatim, so the output round-trips
 against the endpoint byte-for-byte.
+
+``--plan`` runs the elastic control plane's pure planner
+(dragonboat_tpu/control.py) READ-ONLY over the scraped payload — the
+same decision core the NodeHost acts on, fed the same observation, but
+nothing is issued.  It prints transfer / refuse / quiesce counts with
+each decision's evidence row, validates its own output against the
+strict plan schema (control.validate_plan), and exits 1 when any
+action is pending so the flag scripts as a fleet-drift check.  The
+dry-run is per-host and stateless: hysteresis is 1 (a one-observation
+controller has no streak history) and the admission check is advisory
+(the doctor cannot know the host's enforcement mode).
 
 When the payload carries a ``capacity`` section (capacity.py merged
 snapshot), the report adds a capacity block — live/peak bytes, headroom
@@ -126,6 +139,48 @@ def render_groups(info: dict) -> str:
     return "\n".join(lines)
 
 
+def build_plan(info: dict) -> dict:
+    """Dry-run the control planner over a validated info() payload."""
+    from dragonboat_tpu import control
+
+    # hysteresis 1: a throwaway controller sees exactly one observation,
+    # so requiring a streak would plan nothing by construction
+    ctl = control.FleetController(control.ControlPolicy(
+        enabled=True, hysteresis=1, warmup_obs=0))
+    shards = [s for s in info["shards"] if s.get("resident") != "host"]
+    decisions = ctl.observe(info["health"]["worst"], shards)
+    cap = info.get("capacity") or {}
+    limit = int(cap.get("model_max_g_at_budget", 0))
+    adm = control.check_admission(0, len(shards), limit,
+                                  mode=control.ADMISSION_WARN)
+    if adm is not None:
+        decisions.append(adm)
+    quiesced = int((info.get("fleet") or {}).get("quiesced", 0))
+    return control.plan_to_dict(decisions, quiesced)
+
+
+def render_plan(plan: dict) -> str:
+    """Human report for a validated plan_to_dict payload."""
+    c = plan["counts"]
+    lines = [f"plan: transfers={c['transfer']} refusals={c['refuse']}"
+             f" quiesced={c['quiesced']}"]
+    for t in plan["transfers"]:
+        ev = t["evidence"]
+        lines.append(
+            f"  transfer shard {t['shard_id']} -> replica {t['target']}"
+            f"  [lane={ev['lane']} score={ev['score']} lag={ev['lag']}"
+            f" term={ev['term']} host_hot={ev['host_hot']}"
+            f" classes={','.join(ev['classes']) or '-'}]")
+    for r in plan["refusals"]:
+        ev = r["evidence"]
+        lines.append(
+            f"  refuse next-device-replica  [occupied={ev['occupied']}"
+            f" limit={ev['limit']} mode={ev['mode']}]")
+    if not (plan["transfers"] or plan["refusals"]):
+        lines.append("  nothing pending")
+    return "\n".join(lines)
+
+
 def render_shard(si: dict) -> str:
     """Human drill-down for a validated NodeHost.shard_info() payload."""
     lines = [
@@ -173,8 +228,13 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the validated payload as JSON instead of "
                          "the human report")
+    ap.add_argument("--plan", action="store_true",
+                    help="dry-run the control planner over the scraped "
+                         "payload; exit 1 when any action is pending")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args()
+    if args.plan and args.shard is not None:
+        ap.error("--plan reads the whole-host payload; drop --shard")
 
     path = (f"/debug/group/{args.shard}" if args.shard is not None
             else "/debug/groups")
@@ -199,6 +259,17 @@ def main() -> int:
     except ValueError as e:
         print(f"error: schema validation failed: {e}", file=sys.stderr)
         return 2
+
+    if args.plan:
+        from dragonboat_tpu import control
+
+        plan = build_plan(obj)
+        control.validate_plan(plan)
+        if args.json:
+            print(json.dumps({"plan": plan}, indent=2, sort_keys=True))
+        else:
+            print(render_plan(plan))
+        return 1 if plan["transfers"] or plan["refusals"] else 0
 
     if args.json:
         print(json.dumps(obj, indent=2, sort_keys=True))

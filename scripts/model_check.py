@@ -93,6 +93,11 @@ SCOPES = {
     # by kernel-source hash in analysis/safety.py)
     "fast": dict(depth=3, max_states=600),
     "deep": dict(depth=5, max_states=20000),
+    # quiesced=True seeds with a banked election clock: the natural
+    # entry path needs e_timeout*10 idle ticks — unreachable at these
+    # depths — so the scope seeds the mask directly and checks the
+    # quiesced_no_campaign / quiesced_no_vote invariants
+    "quiesce": dict(depth=3, max_states=600, quiesce=True),
 }
 
 KERNEL_FILE = os.path.join("dragonboat_tpu", "core", "kernel.py")
@@ -124,6 +129,14 @@ MUTATIONS = {
     "double_vote": (
         "    can_grant = (s.vote == 0) | (s.vote == m.from_)\n",
         "    can_grant = (s.vote == 0) | (s.vote != 0)\n",
+    ),
+    # tick masking ignores the device-resident quiesced mask: a
+    # quiesced lane with a banked election clock campaigns while its
+    # mask is still raised (caught by quiesced_no_campaign under the
+    # quiesce scope's seeded-mask states)
+    "quiesce_campaigns": (
+        "    q_any = inp.quiesced | s.quiesced\n",
+        "    q_any = inp.quiesced\n",
     ),
 }
 
@@ -561,7 +574,28 @@ class ModelChecker:
         seeds.append(n)                               # entry committed
         seeds.append(advance(tick=False, propose=leader,
                              label="seed:proposed2"))
+        if self.scope.get("quiesce"):
+            return self._quiesce_seeds(seeds)
         return seeds
+
+    def _quiesce_seeds(self, seeds: list[Node]) -> list[Node]:
+        """Quiesced variants of the init and entry-committed seeds: the
+        mask is raised directly (the natural e_timeout*10 idle entry is
+        outside the depth bound) and the election clock is banked past
+        the largest randomized timeout, so any tick-path bug that
+        ignores the mask campaigns on its very first step."""
+        out: list[Node] = []
+        for i, base in enumerate((seeds[0], seeds[-2])):
+            arrs = {f: a.copy() for f, a in base.arrs.items()}
+            arrs["quiesce_on"][:] = True
+            arrs["quiesced"][:] = True
+            arrs["idle_tick"][:] = ELECTION_TIMEOUT * 10
+            arrs["e_tick"][:] = 2 * ELECTION_TIMEOUT
+            out.append(Node(
+                arrs=arrs, net=base.net, isolated=-1, part_used=False,
+                depth=0, leaders=dict(base.leaders),
+                trail=(f"seed:quiesced{i}",)))
+        return out
 
     # -- BFS --------------------------------------------------------------
     def run(self) -> dict:

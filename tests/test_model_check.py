@@ -58,6 +58,24 @@ def test_checker_catches_mutation(mutation, expect):
     assert res["violations"][0]["trail"]
 
 
+def test_quiesce_scope_clean_and_catches_masked_campaign():
+    """The quiesce scope seeds quiesced=True states directly (natural
+    entry needs e_timeout*10 idle ticks, outside the depth bound): the
+    real kernel must hold quiesced_no_campaign / quiesced_no_vote, and
+    a kernel whose tick path ignores the device mask must be caught."""
+    res = mc.run_scope("quiesce")
+    assert res["violations"] == [], res["violations"]
+    assert res["scope_complete"]
+    assert {"invariant:quiesced_no_campaign",
+            "invariant:quiesced_no_vote"} <= set(res["properties"])
+
+    mut = mc.run_scope("quiesce", mutation="quiesce_campaigns")
+    assert mut["violations"], "quiesce_campaigns escaped the quiesce scope"
+    names = " ".join(v["property"] for v in mut["violations"])
+    assert "quiesced_no_campaign" in names, mut["violations"][:3]
+    assert mut["violations"][0]["trail"]
+
+
 def test_mutation_snippets_track_kernel_source():
     src = open(os.path.join(
         REPO, "dragonboat_tpu", "core", "kernel.py")).read()
@@ -79,5 +97,5 @@ def test_every_seeded_bug_is_caught_by_some_leg():
     fall through both legs."""
     from tests.test_safety import STATIC_OWNER
 
-    checker_owned = {"double_vote"}
+    checker_owned = {"double_vote", "quiesce_campaigns"}
     assert set(mc.MUTATIONS) == checker_owned | set(STATIC_OWNER)
