@@ -54,7 +54,10 @@ run_health_ab), BENCH_PIPELINE=1
 pipelined step loops with commit-latency percentiles per arm — see
 run_pipeline_ab), BENCH_TRACE=1 (standalone mode: interleaved A-B
 overhead of proposal-lifecycle tracing at default 1/64 sampling on the
-full serving path — see run_trace_ab), BENCH_CAPACITY=1 (standalone
+full serving path — see run_trace_ab), BENCH_FABRIC=1 (standalone
+mode: interleaved A-B overhead of the fabric observability stack —
+per-link transport telemetry + trace propagation + hop census on top
+of lifecycle tracing — see run_fabric_ab), BENCH_CAPACITY=1 (standalone
 mode: interleaved A-B overhead of the capacity rail — compile-tracker
 wrappers + tree-bytes walk + snapshot assembly — on top of the
 stats+health path — see run_capacity_ab), BENCH_SAFETY=1 (standalone
@@ -1940,6 +1943,181 @@ def run_trace_ab() -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_fabric_ab() -> None:
+    """BENCH_FABRIC=1: interleaved A-B overhead of the full fabric
+    observability stack (fabric.py) — per-link transport telemetry +
+    trace propagation + hop census — on top of lifecycle tracing.
+
+    Same harness as run_trace_ab (3 in-process NodeHosts, chan
+    transport, device-resident shards, continuous pipelined writers)
+    but the arms toggle BOTH dials together: arm A = tracer off +
+    fabric meter off, arm B = tracer at the default 1-in-64 sampling +
+    fabric meter on, so the B arm pays the per-batch link tallies AND
+    the sampled header/census path — the whole round-16 addition.
+    Knobs: BENCH_FABRIC_SHARDS (default 16), BENCH_FABRIC_SECONDS (per
+    window, default 4), BENCH_FABRIC_WINDOW (pipelined proposals per
+    shard, 16), BENCH_FABRIC_EVERY (sampling rate in arm B, 64)."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _t
+    from collections import deque
+
+    import jax
+
+    from dragonboat_tpu import fabric, lifecycle
+    from dragonboat_tpu.client import Session
+    from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.statemachine import IStateMachine, Result
+
+    class NullSM(IStateMachine):
+        def __init__(self, *a):
+            self.n = 0
+
+        def update(self, entry):
+            self.n += 1
+            return Result(value=self.n)
+
+        def lookup(self, q):
+            return self.n
+
+        def save_snapshot(self, w, files, done):
+            w.write(b"\x00")
+
+        def recover_from_snapshot(self, r, files, done):
+            r.read(1)
+
+    platform = jax.devices()[0].platform
+    n_shards = int(os.environ.get("BENCH_FABRIC_SHARDS", "16"))
+    seconds = float(os.environ.get("BENCH_FABRIC_SECONDS", "4"))
+    window = int(os.environ.get("BENCH_FABRIC_WINDOW", "16"))
+    every = int(os.environ.get("BENCH_FABRIC_EVERY", "64"))
+    shards = tuple(range(1, n_shards + 1))
+    addrs = {1: "fb-1", 2: "fb-2", 3: "fb-3"}
+    ex = ExpertConfig(kernel_log_cap=128, kernel_capacity=n_shards,
+                      kernel_apply_batch=32,
+                      kernel_compaction_overhead=16,
+                      trace_sample_every=0,      # arm A state at start
+                      fabric_telemetry=False)
+    hosts = {}
+    root = tempfile.mkdtemp(prefix="dbtpu-fabric-")
+    stop = threading.Event()
+    writers = []
+    try:
+        for rid, addr in addrs.items():
+            nh = NodeHost(NodeHostConfig(
+                raft_address=addr, rtt_millisecond=2, expert=ex,
+                node_host_dir=os.path.join(root, f"nh{rid}")))
+            hosts[rid] = nh
+            for sid in shards:
+                nh.start_replica(addrs, False, NullSM, Config(
+                    shard_id=sid, replica_id=rid, election_rtt=10,
+                    heartbeat_rtt=2, device_resident=True))
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            if all(any(hosts[r].get_leader_id(s)[1] for r in addrs)
+                   for s in shards):
+                break
+            _t.sleep(0.1)
+
+        acked = [0] * n_shards
+
+        def writer(i: int, sid: int) -> None:
+            sess = Session.new_noop_session(sid)
+
+            def leader_host():
+                lid, ok = hosts[1].get_leader_id(sid)
+                return hosts[lid if ok and lid in hosts else 1]
+
+            futs: deque = deque()
+            payload = b"x" * 16
+            while not stop.is_set():
+                try:
+                    nh = leader_host()
+                    while len(futs) < window:
+                        futs.append(nh.propose(sess, payload,
+                                               timeout_s=10.0))
+                    futs.popleft().get(10.0)
+                    acked[i] += 1
+                except Exception:
+                    futs.clear()
+                    _t.sleep(0.02)
+
+        writers = [threading.Thread(target=writer, args=(i, sid),
+                                    daemon=True)
+                   for i, sid in enumerate(shards)]
+        for t in writers:
+            t.start()
+        _t.sleep(1.0)    # settle: windows full, elections over
+
+        def step_totals() -> tuple[int, int]:
+            steps = us = 0
+            for nh in hosts.values():
+                snap = nh.events.metrics.snapshot()
+                steps += snap.get("engine.kernel_step.steps", 0)
+                us += snap.get("engine.kernel_step.total_us", 0)
+            return steps, us
+
+        def measure(sample_every: int, fabric_on: bool) -> dict:
+            lifecycle.TRACER.configure(sample_every=sample_every)
+            fabric.METER.configure(enabled=fabric_on)
+            _t.sleep(0.2)    # flush windows staged under the old arm
+            s0, u0 = step_totals()
+            w0 = sum(acked)
+            _t.sleep(seconds)
+            s1, u1 = step_totals()
+            w1 = sum(acked)
+            return {
+                "steps": s1 - s0,
+                "step_ms": round((u1 - u0) / max(1, s1 - s0) / 1e3, 3),
+                "writes_per_s": round((w1 - w0) / seconds),
+            }
+
+        a_runs, b_runs = [], []
+        measure(0, False)    # warm one throwaway window
+        for _ in range(3):
+            a_runs.append(measure(0, False))
+            b_runs.append(measure(every, True))
+        stop.set()
+        a = sorted(r["step_ms"] for r in a_runs)[1]
+        b = sorted(r["step_ms"] for r in b_runs)[1]
+        overhead_pct = (b - a) / a * 100.0
+        snap = fabric.METER.snapshot()
+        emit({
+            "metric": (f"fabric-telemetry step-latency overhead, "
+                       f"{n_shards} shards x 3 replicas, serving path, "
+                       f"tracer+meter vs neither, sampling 1/{every}"),
+            "value": round(overhead_pct, 2),
+            "unit": "% vs fabric-off arm",
+            "vs_baseline": 0.0,
+            "detail": {
+                "platform": platform,
+                "shards": n_shards,
+                "window": window,
+                "seconds_per_window": seconds,
+                "sample_every": every,
+                "off_arm": a_runs,
+                "on_arm": b_runs,
+                "off_step_ms": a,
+                "on_step_ms": b,
+                "links_seen": len(snap["links"]),
+                "census_finished": snap["census"]["finished"],
+                "p50_commit_host_hops":
+                    snap["census"]["p50_commit_host_hops"],
+                "policy": "median-of-3 interleaved windows per arm, "
+                          "continuous traffic, both dials per arm",
+            },
+        })
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=15)
+        for nh in hosts.values():
+            nh.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_pipeline_ab() -> None:
     """BENCH_PIPELINE=1: A-B of the serial depth-0 loop vs the fused
     depth-1 pipelined loop (PR 6) at MATCHED micro-step counts — the
@@ -2309,6 +2487,14 @@ def main() -> None:
             import traceback
 
             fail("capacity-ab", traceback.format_exc())
+        return
+    if os.environ.get("BENCH_FABRIC") == "1":
+        try:
+            run_fabric_ab()
+        except Exception:
+            import traceback
+
+            fail("fabric-ab", traceback.format_exc())
         return
     if os.environ.get("BENCH_TRACE") == "1":
         try:

@@ -56,6 +56,9 @@ DEFAULT_MODULES = (
     "dragonboat_tpu/lifecycle.py",
     "dragonboat_tpu/core/health.py",
     "dragonboat_tpu/capacity.py",
+    "dragonboat_tpu/fabric.py",
+    "dragonboat_tpu/transport/chan.py",
+    "dragonboat_tpu/transport/tcp.py",
     # the fleet controller: lockless BY CONTRACT (all state advances
     # under the NodeHost tick, never from transport threads) — listed so
     # the day it grows a lock, its streak/cooldown dicts must declare

@@ -50,6 +50,11 @@ DEFAULT_GLOBS = (
     # the capacity rail too: the compile tracker's clock is injected,
     # flight records are stamped with call counts, never wall time
     "dragonboat_tpu/capacity.py",
+    # the fabric meter: same injected-clock contract as the lifecycle
+    # tracer (delivery latencies and remote-span stamps come off the
+    # injected microsecond clock), and distinct-host sets are
+    # insertion-ordered dicts so snapshots carry no set-order noise
+    "dragonboat_tpu/fabric.py",
     # the elastic controller: decisions must be a pure function of the
     # observation sequence (digest + seeded splitmix32 tie-break) so a
     # replayed flight record reproduces every transfer — no wall clock,

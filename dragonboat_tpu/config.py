@@ -192,6 +192,13 @@ class ExpertConfig:
     # default keeps chaos-replay flight tails byte-identical, since the
     # breakdown carries measured wall durations)
     trace_slow_commit_us: int = 0
+    # fabric observability (fabric.py): per-(src,dst)-link transport
+    # telemetry, the cross-host trace header on outbound batches, and
+    # the commit-path hop census behind /debug/fabric and
+    # info()["fabric"].  False stops link accounting and keeps frames
+    # header-free (sampled spans still stamp hub_send/hub_recv
+    # in-process)
+    fabric_telemetry: bool = True
     # capacity rail (capacity.py): memory_pressure trips when headroom
     # against the device budget drops below the watermark; budget 0 uses
     # the backend-reported bytes_limit (and disables the trip where the

@@ -53,12 +53,26 @@ STAGE_FSYNC = "fsync"              # durable logdb flush completed
 STAGE_APPLY_QUEUE = "apply_queue"  # handed to the apply pool
 STAGE_APPLY = "apply"              # RSM update executed
 STAGE_HUB_SEND = "hub_send"        # replicate left the transport hub
-STAGE_HUB_RECV = "hub_recv"        # replicate arrived (chan sidecar)
+STAGE_HUB_RECV = "hub_recv"        # replicate arrived (every transport)
+STAGE_ACK_RETURN = "ack_return"    # quorum ack returned to the origin
+#                                    host (stamped by fabric.METER off
+#                                    the trace header's return context)
 STAGE_ACK = "ack"                  # future completed
 
 STAGES = (STAGE_PROPOSE, STAGE_STAGE, STAGE_DISPATCH, STAGE_RETIRE,
           STAGE_SAVE, STAGE_FSYNC, STAGE_APPLY_QUEUE, STAGE_APPLY,
-          STAGE_HUB_SEND, STAGE_HUB_RECV, STAGE_ACK)
+          STAGE_HUB_SEND, STAGE_HUB_RECV, STAGE_ACK_RETURN, STAGE_ACK)
+
+# read-path stage taxonomy (ROADMAP item 3's attribution prerequisite):
+# a sampled ReadIndex gets its own span kind with these stamps
+STAGE_READ_PROPOSE = "read_propose"  # ReadIndex enqueued (request book)
+STAGE_READ_QUORUM = "read_quorum"    # quorum round confirmed the index
+STAGE_READ_SERVE = "read_serve"      # applied index caught up, read served
+
+READ_STAGES = (STAGE_READ_PROPOSE, STAGE_READ_QUORUM, STAGE_READ_SERVE)
+
+KIND_PROPOSAL = "proposal"
+KIND_READ = "read"
 
 # host stage -> the tracing.annotate span name covering the same work in
 # a jax.profiler device capture; Perfetto shows both timelines and these
@@ -72,13 +86,15 @@ DEFAULT_SAMPLE_EVERY = 64
 
 
 class _Span:
-    """One sampled proposal's stamp list (append-only, time-ordered)."""
+    """One sampled span's stamp list (append-only, time-ordered)."""
 
-    __slots__ = ("key", "shard_id", "stamps")
+    __slots__ = ("key", "shard_id", "kind", "stamps")
 
-    def __init__(self, key: int, shard_id: int) -> None:
+    def __init__(self, key: int, shard_id: int,
+                 kind: str = KIND_PROPOSAL) -> None:
         self.key = key
         self.shard_id = shard_id
+        self.kind = kind
         self.stamps: list[tuple[str, int]] = []   # (stage, t_us)
 
 
@@ -105,6 +121,10 @@ class LifecycleTracer:
             else telemetry.GLOBAL
         self._recorder = recorder if recorder is not None \
             else flight.RECORDER
+        # completion hooks (fabric.py's hop census): fired OUTSIDE mu
+        # with (key, kind) after a span finishes / is scrubbed
+        self._on_finish = None
+        self._on_scrub = None
         self._stage_hist = self._registry.histogram(
             "commit_stage_us",
             help="per-stage commit latency attribution of sampled "
@@ -132,6 +152,16 @@ class LifecycleTracer:
             if slow_commit_us is not None:
                 self._slow_us = max(0, int(slow_commit_us))
 
+    def set_hooks(self, on_finish=None, on_scrub=None) -> None:
+        """Register span-completion callbacks ``fn(key, kind)``, fired
+        outside ``mu`` after ``finish``/``scrub`` retire a live span.
+        One consumer (``fabric.METER``'s hop census); later writers
+        replace earlier ones.  Callbacks must not call back into the
+        tracer's span verbs for the same key."""
+        with self.mu:
+            self._on_finish = on_finish
+            self._on_scrub = on_scrub
+
     # -- span lifecycle ----------------------------------------------------
 
     def begin(self, key: int, shard_id: int = 0) -> bool:
@@ -143,6 +173,24 @@ class LifecycleTracer:
         t = self._clock()
         sp = _Span(key, shard_id)
         sp.stamps.append((STAGE_PROPOSE, t))
+        with self.mu:
+            if key in self._spans:
+                return False
+            if len(self._spans) >= self._max_active:
+                self._dropped += 1
+                return False
+            self._spans[key] = sp
+        return True
+
+    def begin_read(self, key: int, shard_id: int = 0) -> bool:
+        """Open a READ span for a sampled ReadIndex key: same book and
+        bounds as ``begin``, first stamp ``read_propose``, completed by
+        ``finish`` at serve time with a ``read_total`` observation."""
+        if not self.sampled(key):
+            return False
+        t = self._clock()
+        sp = _Span(key, shard_id, kind=KIND_READ)
+        sp.stamps.append((STAGE_READ_PROPOSE, t))
         with self.mu:
             if key in self._spans:
                 return False
@@ -164,7 +212,8 @@ class LifecycleTracer:
                 sp.stamps.append((stage, t))
 
     def finish(self, key: int) -> None:
-        """Complete a span at future-ack time: stamp ``ack``, feed the
+        """Complete a span at future-ack time: stamp the closing stage
+        (``ack`` for proposals, ``read_serve`` for reads), feed the
         per-stage histograms, retire the trace into the ring, and record
         a slow-commit flight event when the SLO is exceeded."""
         if not self.sampled(key):
@@ -174,26 +223,35 @@ class LifecycleTracer:
             sp = self._spans.pop(key, None)
             if sp is None:
                 return
-            sp.stamps.append((STAGE_ACK, t))
+            closing = STAGE_ACK if sp.kind == KIND_PROPOSAL \
+                else STAGE_READ_SERVE
+            sp.stamps.append((closing, t))
             self._finished += 1
             total = sp.stamps[-1][1] - sp.stamps[0][1]
             trace = {"key": sp.key, "shard_id": sp.shard_id,
-                     "stamps": list(sp.stamps), "total_us": total}
+                     "kind": sp.kind, "stamps": list(sp.stamps),
+                     "total_us": total}
             self._ring.append(trace)
-            slow = self._slow_us > 0 and total >= self._slow_us
+            slow = (sp.kind == KIND_PROPOSAL and self._slow_us > 0
+                    and total >= self._slow_us)
+            hook = self._on_finish
         # sinks run outside mu: the histogram and recorder take their
         # own locks, and nothing here needs the span book anymore
         prev = sp.stamps[0][1]
         for stage, ts in sp.stamps[1:]:
             self._stage_hist.labels(stage).observe(ts - prev)
             prev = ts
-        self._stage_hist.labels("total").observe(total)
+        self._stage_hist.labels(
+            "total" if sp.kind == KIND_PROPOSAL else "read_total"
+        ).observe(total)
         if slow:
             t0 = sp.stamps[0][1]
             self._recorder.record(
                 flight.SLOW_COMMIT, key=sp.key, shard_id=sp.shard_id,
                 total_us=total, slo_us=self._slow_us,
                 stages=[[stage, ts - t0] for stage, ts in sp.stamps])
+        if hook is not None:
+            hook(key, sp.kind)
 
     def scrub(self, key: int) -> None:
         """End a span that can no longer complete (dropped / timed-out /
@@ -202,8 +260,12 @@ class LifecycleTracer:
         if not self.sampled(key):
             return
         with self.mu:
-            if self._spans.pop(key, None) is not None:
+            sp = self._spans.pop(key, None)
+            if sp is not None:
                 self._scrubbed += 1
+            hook = self._on_scrub
+        if sp is not None and hook is not None:
+            hook(key, sp.kind)
 
     # -- introspection / export -------------------------------------------
 
@@ -246,8 +308,8 @@ class LifecycleTracer:
             for i, (stage, ts) in enumerate(stamps):
                 dur = (stamps[i + 1][1] - ts) if i + 1 < len(stamps) else 0
                 events.append({
-                    "name": stage, "cat": "proposal", "ph": "X",
-                    "ts": ts, "dur": dur,
+                    "name": stage, "cat": tr.get("kind", KIND_PROPOSAL),
+                    "ph": "X", "ts": ts, "dur": dur,
                     "pid": tr["shard_id"], "tid": tr["key"],
                     "args": {"key": tr["key"],
                              "annotation": ANNOTATION_OF.get(stage, "")},

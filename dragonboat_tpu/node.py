@@ -92,7 +92,8 @@ class Node:
         self._owns_clock = clock is None
         self.pending_proposals = PendingProposal(clock=self._clock,
                                                  shard_id=cfg.shard_id)
-        self.pending_reads = PendingReadIndex(clock=self._clock)
+        self.pending_reads = PendingReadIndex(clock=self._clock,
+                                              shard_id=cfg.shard_id)
         self.pending_config_change = PendingSingleton(clock=self._clock)
         self.pending_snapshot = PendingSingleton(clock=self._clock)
         self.pending_transfer = PendingSingleton(clock=self._clock)

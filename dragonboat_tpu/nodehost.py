@@ -39,9 +39,10 @@ from dragonboat_tpu.request import (
 )
 from dragonboat_tpu.rsm.statemachine import StateMachine
 from dragonboat_tpu.statemachine import Result
+from dragonboat_tpu import fabric
 from dragonboat_tpu.transport.chan import ChanTransportFactory
 from dragonboat_tpu.transport.chunks import ChunkSink
-from dragonboat_tpu.transport.hub import TransportHub
+from dragonboat_tpu.transport.hub import TransportHub, _msg_size
 from dragonboat_tpu.logger import get_logger
 
 _LOG = get_logger("nodehost")
@@ -293,6 +294,10 @@ class NodeHost:
         _lifecycle.TRACER.configure(
             sample_every=nhconfig.expert.trace_sample_every,
             slow_commit_us=nhconfig.expert.trace_slow_commit_us)
+        # fabric link telemetry + hop census (fabric.py): the meter is
+        # process-wide for the same reason the tracer is — links span
+        # hosts, so one registry must see both ends
+        fabric.METER.configure(enabled=nhconfig.expert.fabric_telemetry)
         # opt-in persistent jit compile cache (hostenv): geometry sweeps
         # and restarts stop paying full recompiles
         if nhconfig.expert.compile_cache:
@@ -317,7 +322,9 @@ class NodeHost:
                 info_source=self.info,
                 shard_info_source=self._shard_info_or_none,
                 capacity_source=self._capacity_snapshot,
-                invariants_source=self._invariants_snapshot)
+                invariants_source=self._invariants_snapshot,
+                fabric_source=fabric.METER.snapshot,
+                fabric_trace_source=fabric.METER.chrome_events)
             _LOG.info("NodeHost %s metrics endpoint on %s",
                       nhconfig.raft_address, self._metrics_server.address)
         self._auto_run = auto_run
@@ -1269,6 +1276,15 @@ class NodeHost:
             for m in batch.requests:
                 if m.from_ != 0:
                     self.registry.add(m.shard_id, m.from_, batch.source_address)
+        # fabric inbound seam: BOTH transports funnel here, so one call
+        # covers per-link recv accounting, delivery latency off the
+        # header's sender stamp, hub_recv span stamping (the PR 7 fix),
+        # and the remote child span + hop-census bookkeeping.  The byte
+        # estimate mirrors the hub's send-side _msg_size so the two ends
+        # of a link stay comparable
+        fabric.METER.on_batch_received(
+            self.config.raft_address, batch,
+            nbytes=sum(_msg_size(m) for m in batch.requests))
         for m in batch.requests:
             with self.mu:
                 node = self.nodes.get(m.shard_id)
@@ -1712,6 +1728,7 @@ class NodeHost:
             "health": self._health_snapshot(),
             "capacity": self._capacity_snapshot(),
             "fleet": self._fleet_snapshot(),
+            "fabric": fabric.METER.snapshot(),
             "shards": shards,
         }
 

@@ -320,13 +320,83 @@ _RESPONSE_TYPES = frozenset(
 
 
 @dataclass(frozen=True, slots=True)
+class FabricContext:
+    """One sampled proposal's trace context riding a transport frame
+    (fabric.py cross-host propagation): the lifecycle trace key, the
+    origin NodeHost address, the shard, and the host-hub hop count so
+    far.  ``origin == receiver`` marks a context returning home (the
+    quorum ack), anything else an outbound replicate."""
+
+    key: int = 0
+    origin: str = ""
+    hop: int = 0
+    shard_id: int = 0
+
+
+@dataclass(frozen=True, slots=True)
+class FabricHeader:
+    """Versioned optional trace header on a MessageBatch.  Absent by
+    default — old frames (and old peers) carry/see nothing; the native
+    wire appends it as a magic-guarded trailer the old decoder ignores,
+    the go wire ships it in an unknown-to-the-reference protobuf field
+    its decoder skips.  ``sent_us`` is the sender's injected monotonic
+    clock at flush time (per-link delivery-latency attribution)."""
+
+    version: int = 1
+    sent_us: int = 0
+    ctxs: tuple[FabricContext, ...] = ()
+
+
+# bump when the FabricContext layout changes; decoders return None for
+# versions they do not understand (mixed-version clusters interop — the
+# header degrades to absent, never to a parse error)
+FABRIC_WIRE_VERSION = 1
+# native-wire trailer guard: little-endian b"FBH1" after the message
+# array (still inside the CRC-covered body)
+_FABRIC_MAGIC = 0x31484246
+
+
+def encode_fabric_header(h: FabricHeader) -> bytes:
+    """Version-prefixed header blob shared by both wire formats."""
+    buf = bytearray(struct.pack("<BQI", h.version, h.sent_us, len(h.ctxs)))
+    for c in h.ctxs:
+        o = c.origin.encode()
+        buf += struct.pack("<QQII", c.key, c.shard_id, c.hop, len(o))
+        buf += o
+    return bytes(buf)
+
+
+def decode_fabric_header(data) -> FabricHeader | None:
+    """None for an unknown version (forward compat), raises on a
+    truncated blob of a known version (corruption, not skew)."""
+    mv = memoryview(data)
+    version, sent_us, n = struct.unpack_from("<BQI", mv, 0)
+    if version != FABRIC_WIRE_VERSION:
+        return None
+    off = 13
+    ctxs = []
+    for _ in range(n):
+        key, shard_id, hop, olen = struct.unpack_from("<QQII", mv, off)
+        off += 24
+        origin = bytes(mv[off:off + olen]).decode()
+        if len(origin.encode()) != olen:
+            raise ValueError("fabric header truncated")
+        off += olen
+        ctxs.append(FabricContext(key=key, origin=origin, hop=hop,
+                                  shard_id=shard_id))
+    return FabricHeader(version=version, sent_us=sent_us, ctxs=tuple(ctxs))
+
+
+@dataclass(frozen=True, slots=True)
 class MessageBatch:
-    """Transport frame — parity with raftpb/messagebatch.go:6."""
+    """Transport frame — parity with raftpb/messagebatch.go:6, plus the
+    optional fabric trace header (absent on old frames)."""
 
     requests: tuple[Message, ...] = ()
     deployment_id: int = 0
     source_address: str = ""
     bin_ver: int = 0
+    fabric: FabricHeader | None = None
 
 
 @dataclass(frozen=True, slots=True)
@@ -606,6 +676,11 @@ def encode_message_batch(b: MessageBatch) -> bytes:
     buf += struct.pack("<I", len(b.requests))
     for m in b.requests:
         encode_message(m, buf)
+    if b.fabric is not None:
+        # versioned optional trailer, still under the CRC: the decoder
+        # reads exactly n messages, so an old peer ignores these bytes
+        buf += struct.pack("<I", _FABRIC_MAGIC)
+        buf += encode_fabric_header(b.fabric)
     crc = zlib.crc32(bytes(buf))
     return struct.pack("<I", crc) + bytes(buf)
 
@@ -626,7 +701,12 @@ def decode_message_batch(data: bytes) -> MessageBatch:
     for _ in range(n):
         m, off = decode_message(body, off)
         msgs.append(m)
-    return MessageBatch(tuple(msgs), deployment_id, src, bin_ver)
+    fabric = None
+    if len(body) - off >= 4:
+        (magic,) = struct.unpack_from("<I", body, off)
+        if magic == _FABRIC_MAGIC:
+            fabric = decode_fabric_header(body[off + 4:])
+    return MessageBatch(tuple(msgs), deployment_id, src, bin_ver, fabric)
 
 
 def encode_bootstrap(b: Bootstrap) -> bytes:

@@ -573,10 +573,17 @@ def decode_message(data) -> pb.Message:
 # --------------------------------------------------------------------------
 
 
+# the fabric trace header rides an unknown-to-the-reference field: the
+# gogo decoder (and ours) skips any unrecognized tag, so a reference
+# peer sees nothing and an old frame simply carries no header
+FABRIC_FIELD = 15
+
+
 def encode_message_batch(requests: Sequence[pb.Message],
                          deployment_id: int = 0,
                          source_address: str = "",
-                         bin_ver: int = 0) -> bytes:
+                         bin_ver: int = 0,
+                         fabric: bytes | None = None) -> bytes:
     out = bytearray()
     for m in requests:
         _tag(out, 1, 2)
@@ -587,16 +594,22 @@ def encode_message_batch(requests: Sequence[pb.Message],
     _bytes(out, source_address.encode())
     _tag(out, 4, 0)
     _uvarint(out, bin_ver)
+    if fabric is not None:
+        _tag(out, FABRIC_FIELD, 2)
+        _bytes(out, fabric)
     return bytes(out)
 
 
 def decode_message_batch(data) -> tuple[
-        tuple[pb.Message, ...], int, str, int]:
-    """-> (requests, deployment_id, source_address, bin_ver)."""
+        tuple[pb.Message, ...], int, str, int, bytes | None]:
+    """-> (requests, deployment_id, source_address, bin_ver, fabric) —
+    ``fabric`` is the raw version-prefixed header blob (field 15) or
+    None when the frame carries no header (old peers)."""
     mv = memoryview(data)
     i = 0
     msgs: list[pb.Message] = []
     dep, src, ver = 0, "", 0
+    fabric: bytes | None = None
     while i < len(mv):
         key, i = _read_uvarint(mv, i)
         field, wire = key >> 3, key & 7
@@ -610,9 +623,12 @@ def decode_message_batch(data) -> tuple[
             src = b.decode()
         elif field == 4 and wire == 0:
             ver, i = _read_uvarint(mv, i)
+        elif field == FABRIC_FIELD and wire == 2:
+            b, i = _read_bytes(mv, i)
+            fabric = bytes(b)
         else:
             i = _skip_field(mv, i, wire)
-    return tuple(msgs), dep, src, ver
+    return tuple(msgs), dep, src, ver, fabric
 
 
 # --------------------------------------------------------------------------

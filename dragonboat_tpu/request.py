@@ -243,21 +243,34 @@ class PendingProposal(_ClockedBook):
 
 class PendingReadIndex(_ClockedBook):
     """ReadIndex completion book (request.go:535): batches reads under a
-    SystemCtx, fires when appliedIndex passes the read index (:930)."""
+    SystemCtx, fires when appliedIndex passes the read index (:930).
+
+    Lifecycle tracing (ROADMAP item 3's attribution prerequisite): read
+    keys come off ``PendingProposal._seq`` — the SAME process-unique
+    counter as entry keys, so sampling stays 1-in-N over all traced
+    operations and a read key can never collide with a proposal span.
+    ``read`` opens the span (``read_propose``), ``add_ready`` stamps the
+    confirmed quorum round (``read_quorum``), ``applied`` finishes it at
+    serve time (``read_serve``); every removal verb scrubs."""
 
     _ctx = itertools.count(1)
 
-    def __init__(self, clock: LogicalClock | None = None) -> None:
+    def __init__(self, clock: LogicalClock | None = None,
+                 shard_id: int = 0) -> None:
         super().__init__(clock)
         self.pending: dict[int, list[RequestState]] = {}   # guarded-by: mu — ctx_low -> readers
         self.batching: list[RequestState] = []             # guarded-by: mu
         self.ready: dict[int, int] = {}                    # guarded-by: mu — ctx_low -> index
         self.waiting: list[tuple[int, RequestState]] = []  # guarded-by: mu — (index, rs)
+        # raft shard id this book serves (Chrome-trace pid grouping)
+        self.shard_id = shard_id                           # guarded-by: <init-only>
 
     def read(self, timeout_ticks: int) -> RequestState:
-        rs = RequestState(deadline_tick=self.tick + timeout_ticks)
+        key = next(PendingProposal._seq)
+        rs = RequestState(key=key, deadline_tick=self.tick + timeout_ticks)
         with self.mu:
             self.batching.append(rs)
+        lifecycle.TRACER.begin_read(key, self.shard_id)
         return rs
 
     def peep(self) -> pb.SystemCtx | None:
@@ -276,6 +289,8 @@ class PendingReadIndex(_ClockedBook):
             if readers is None:
                 return
             self.waiting.extend((index, rs) for rs in readers)
+        for rs in readers:
+            lifecycle.TRACER.stamp(rs.key, lifecycle.STAGE_READ_QUORUM)
 
     def applied(self, applied_index: int) -> None:
         """Fire every waiting read whose index has been applied."""
@@ -290,12 +305,14 @@ class PendingReadIndex(_ClockedBook):
             self.waiting = still
         for rs in fire:
             rs.notify(RequestResult(code=RequestResultCode.COMPLETED))
+            lifecycle.TRACER.finish(rs.key)
 
     def dropped(self, ctx: pb.SystemCtx) -> None:
         with self.mu:
             readers = self.pending.pop(ctx.low, None)
         for rs in readers or ():
             rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+            lifecycle.TRACER.scrub(rs.key)
 
     def gc(self) -> None:
         # unlocked fast path (racy-but-benign: a concurrent add is
@@ -329,8 +346,10 @@ class PendingReadIndex(_ClockedBook):
         for item in dead1 + dead2:
             rs = item[1] if isinstance(item, tuple) else item
             rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+            lifecycle.TRACER.scrub(rs.key)
         for rs in dead3:
             rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+            lifecycle.TRACER.scrub(rs.key)
 
     def terminate_all(self) -> None:
         with self.mu:
@@ -340,6 +359,7 @@ class PendingReadIndex(_ClockedBook):
             self.batching, self.pending, self.waiting = [], {}, []
         for rs in all_rs:
             rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+            lifecycle.TRACER.scrub(rs.key)
 
 
 class PendingSingleton(_ClockedBook):

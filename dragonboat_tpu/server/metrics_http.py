@@ -10,10 +10,13 @@ Perfetto / chrome://tracing — ``/healthz``, and the fleet-health
 drill-down pair ``/debug/groups`` (NodeHost.info(): health summary +
 NodeHostInfo-parity shard list) and ``/debug/group/<id>``
 (NodeHost.shard_info(): one group's O(1) device row + host registers),
-and ``/debug/capacity`` (capacity.py merged snapshot: live/peak bytes,
-headroom, per-entry compile counters).  ``/trace`` merges the compile
-tracker's spans into the lifecycle ring's, so one Perfetto timeline
-shows proposals beside the compiles that stalled them.
+``/debug/capacity`` (capacity.py merged snapshot: live/peak bytes,
+headroom, per-entry compile counters), and ``/debug/fabric``
+(fabric.py: per-link transport telemetry + the commit-path hop
+census).  ``/trace`` merges the compile tracker's spans and the
+fabric meter's remote child spans into the lifecycle ring's, so one
+Perfetto timeline shows proposals beside the compiles that stalled
+them and the remote hosts their quorum rounds touched.
 
 ``/healthz`` is honest: with a ``health_source`` wired (core/health.py
 merged snapshot), any nonzero anomaly-class count turns it into a 503
@@ -50,7 +53,8 @@ class MetricsServer:
                  flight_recorder=None, tracer=None,
                  health_source=None, info_source=None,
                  shard_info_source=None, capacity_source=None,
-                 compile_tracker=None, invariants_source=None) -> None:
+                 compile_tracker=None, invariants_source=None,
+                 fabric_source=None, fabric_trace_source=None) -> None:
         self.registries = list(registries)
         self.flight_recorder = (flight_recorder if flight_recorder
                                 is not None else flight.RECORDER)
@@ -69,6 +73,12 @@ class MetricsServer:
         # protocol-invariant violation is a BUG, so the degradation is
         # sticky (violations_seen, not the instantaneous total)
         self.invariants_source = invariants_source
+        # fabric_source() -> fabric.FabricMeter.snapshot() dict (serves
+        # /debug/fabric); fabric_trace_source() -> remote child spans as
+        # Chrome events, merged into /trace so one Perfetto timeline
+        # shows the origin's span beside every remote host it touched
+        self.fabric_source = fabric_source
+        self.fabric_trace_source = fabric_trace_source
         if compile_tracker is None:
             # imported here, not at module top: capacity.py pulls jax,
             # which importers of this module must not pay for eagerly
@@ -94,16 +104,25 @@ class MetricsServer:
                     ctype = "application/json"
                 elif path == "/trace":
                     # one timeline: proposal spans beside compile spans
-                    # (distinct pid rows in Perfetto / chrome://tracing)
+                    # and the fabric's remote child spans (distinct pid
+                    # rows in Perfetto / chrome://tracing; remote spans
+                    # share the proposal's tid, stitching the hosts)
                     trace = outer.tracer.export_chrome_trace()
-                    trace["traceEvents"] = (
-                        list(trace.get("traceEvents", ()))
-                        + outer.compile_tracker.chrome_events())
+                    events = (list(trace.get("traceEvents", ()))
+                              + outer.compile_tracker.chrome_events())
+                    if outer.fabric_trace_source is not None:
+                        events += outer.fabric_trace_source()
+                    trace["traceEvents"] = events
                     body = (json.dumps(trace, sort_keys=True)
                             + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":
                     status, body, ctype = outer.healthz()
+                elif path == "/debug/fabric" and outer.fabric_source:
+                    body = (json.dumps(outer.fabric_source(),
+                                       sort_keys=True)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/debug/capacity" and outer.capacity_source:
                     body = (json.dumps(outer.capacity_source(),
                                        sort_keys=True)

@@ -11,7 +11,6 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
-from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
 
@@ -19,7 +18,7 @@ from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
 class _Registry:
     def __init__(self) -> None:
         self.mu = threading.RLock()
-        self.listening: dict[str, "ChanTransport"] = {}
+        self.listening: dict[str, "ChanTransport"] = {}  # guarded-by: mu
 
     def register(self, addr: str, t: "ChanTransport") -> None:
         with self.mu:
@@ -118,6 +117,9 @@ class ChanTransport(ITransport):
                 deployment_id=batch.deployment_id,
                 source_address=batch.source_address,
                 bin_ver=batch.bin_ver,
+                # the fabric trace header survives chaos rewrites — a
+                # dropped/duplicated message keeps the batch's contexts
+                fabric=batch.fabric,
             )
         if self.delay_func is not None:
             delays = [self.delay_func(m) for m in batch.requests]
@@ -125,17 +127,9 @@ class ChanTransport(ITransport):
             if d > 0:
                 threading.Timer(d, self.message_handler, (batch,)).start()
                 return
-        # lifecycle sidecar (in-proc transport only): sampled replicate
-        # entries arrived at the destination host — the process-global
-        # tracer sees the proposer's span directly, so nothing is encoded
-        # into the batch and the wire formats stay untouched
-        if lifecycle.TRACER.enabled:
-            for m in batch.requests:
-                if m.type == pb.MessageType.REPLICATE:
-                    for e in m.entries:
-                        if e.key:
-                            lifecycle.TRACER.stamp(
-                                e.key, lifecycle.STAGE_HUB_RECV)
+        # hub_recv stamping moved to the NodeHost inbound seam
+        # (fabric.METER.on_batch_received) — one site covering chan AND
+        # tcp, off the fabric header when the sender attached one
         self.message_handler(batch)
 
     def deliver_chunk(self, chunk: dict) -> None:

@@ -15,6 +15,7 @@ from collections import deque
 from random import Random
 from typing import Callable
 
+from dragonboat_tpu import fabric
 from dragonboat_tpu import flight
 from dragonboat_tpu import lifecycle
 from dragonboat_tpu import raftpb as pb
@@ -146,6 +147,10 @@ class TransportHub:
                 "transport.breakers", self._breaker_states,
                 help="per-address circuit breakers by current state",
                 labelnames=("state",))
+        # per-link fabric telemetry: the meter folds this hub's queue
+        # depths and breaker states into /debug/fabric (weakly held —
+        # a closed hub just vanishes from the snapshot)
+        fabric.METER.attach_hub(source_address, self)
 
     def _breaker_states(self) -> dict[tuple[str, ...], float]:
         """Callback-gauge source: breaker count per state.  Copies the
@@ -239,12 +244,18 @@ class TransportHub:
                 if not q:
                     continue
                 msgs = tuple(m for m, _ in q)
+                nbytes = sum(s for _, s in q)
                 q.clear()
                 self.queue_bytes[a] = 0
+            # fabric trace header: sampled replicate keys + parked
+            # quorum-ack returns ride the frame (None when empty, so
+            # the bytes are identical to an old peer's frame)
+            header = fabric.METER.header_for(self.source_address, a, msgs)
             batch = pb.MessageBatch(
                 requests=msgs,
                 deployment_id=self.deployment_id,
                 source_address=self.source_address,
+                fabric=header,
             )
             b = self.breaker(a)
             try:
@@ -253,8 +264,8 @@ class TransportHub:
                 b.succeed()
                 self.metrics.inc("transport.sent", len(msgs))
                 # lifecycle sidecar: replicated entries left this host —
-                # stamp the sampled spans in-process (nothing rides the
-                # wire; go-wire interop is untouched)
+                # stamp the sampled spans (flush is transport-agnostic,
+                # so hub_send covers chan AND tcp)
                 if lifecycle.TRACER.enabled:
                     for m in msgs:
                         if m.type == pb.MessageType.REPLICATE:
@@ -262,6 +273,8 @@ class TransportHub:
                                 if e.key:
                                     lifecycle.TRACER.stamp(
                                         e.key, lifecycle.STAGE_HUB_SEND)
+                fabric.METER.on_send(self.source_address, a, msgs,
+                                     nbytes, header)
                 self._note_connection(a, True, False)
             except Exception:
                 if b.fail():
@@ -331,6 +344,9 @@ class TransportHub:
             bps = self.snapshot_send_bps
             for c in chunks:
                 conn.send_chunk(c)
+                fabric.METER.on_chunk_sent(
+                    self.source_address, addr,
+                    len(getattr(c, "data", b"")))
                 if bps > 0:
                     self._pace_snapshot(len(getattr(c, "data", b"")), bps)
             b.succeed()

@@ -107,7 +107,11 @@ class _TCPConn:
                     # a real Go receiver REJECTS BinVer != 210
                     # (transport.go:312); the hub builds batches with
                     # the default 0
-                    batch.bin_ver or gowire.TRANSPORT_BIN_VERSION)
+                    batch.bin_ver or gowire.TRANSPORT_BIN_VERSION,
+                    # fabric trace header rides as an optional field a
+                    # reference peer's _skip_field ignores
+                    fabric=(pb.encode_fabric_header(batch.fabric)
+                            if batch.fabric is not None else None))
                 # one buffer, one syscall: with TCP_NODELAY a separate
                 # magic write would emit its own 2-byte segment per batch
                 self.sock.sendall(GO_MAGIC +
@@ -193,10 +197,10 @@ class TCPTransport(ITransport):
         # read-and-discarded and outbound sends fail (partition_node)
         self.partitioned = False
         self.mu = threading.Lock()
-        self.conns: dict[str, _TCPConn] = {}
+        self.conns: dict[str, _TCPConn] = {}               # guarded-by: mu
         self.running = False
         self._listener: socket.socket | None = None
-        self._accepted: set[socket.socket] = set()
+        self._accepted: set[socket.socket] = set()         # guarded-by: mu
 
     def name(self) -> str:
         return ("tcp-transport" if self.wire == "native"
@@ -309,11 +313,15 @@ class TCPTransport(ITransport):
                     if self.wire == "go":
                         from dragonboat_tpu.raftpb import gowire
 
-                        reqs, dep, src, ver = gowire.decode_message_batch(
-                            payload)
+                        reqs, dep, src, ver, fab = \
+                            gowire.decode_message_batch(payload)
                         batch = pb.MessageBatch(
                             requests=reqs, deployment_id=dep,
-                            source_address=src, bin_ver=ver)
+                            source_address=src, bin_ver=ver,
+                            # None for an absent blob or an unknown
+                            # header version (old/new peer — drop it)
+                            fabric=(pb.decode_fabric_header(fab)
+                                    if fab is not None else None))
                     else:
                         batch = pb.decode_message_batch(payload)
                     self.message_handler(batch)

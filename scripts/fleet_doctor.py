@@ -38,9 +38,17 @@ against the device budget, the contracts-model prediction, and the
 per-entry compile/retrace counters — and memory pressure or a retrace
 storm counts as degraded alongside the anomaly classes.
 
+``--fabric`` scrapes ``/debug/fabric`` (fabric.py per-link transport
+telemetry + hop census), validates it strictly
+(fabric.validate_fabric), and prints the hottest links — top-K by bytes
+sent and by p99 delivery latency — the hop-census summary, and each
+attached hub's queue depth and breaker states.  Any non-closed breaker
+counts as degraded (exit 1).  ``--top`` sizes K.
+
 Exit status: 0 healthy, 1 degraded (any anomaly class nonzero, memory
-pressure, or a retrace storm), 2 unreachable or schema-invalid.
-Stdlib-only on the wire (urllib).
+pressure, a retrace storm, or — under ``--fabric`` — a tripped
+breaker), 2 unreachable or schema-invalid.  Stdlib-only on the wire
+(urllib).
 """
 
 from __future__ import annotations
@@ -181,6 +189,62 @@ def render_plan(plan: dict) -> str:
     return "\n".join(lines)
 
 
+def _fabric_degraded(fab: dict) -> list[str]:
+    """Non-closed breakers across the attached hubs (degradation)."""
+    out = []
+    for addr in sorted(fab["hubs"]):
+        for peer, state in sorted(fab["hubs"][addr]["breakers"].items()):
+            if state != "closed":
+                out.append(f"{addr}->{peer}={state}")
+    return out
+
+
+def render_fabric(fab: dict, top_k: int = 5) -> str:
+    """Human report for a validated /debug/fabric payload: hottest
+    links by bytes and by p99 delivery latency, the hop-census summary,
+    and per-hub queue/breaker state."""
+    tripped = _fabric_degraded(fab)
+    cen = fab["census"]
+    lines = [
+        f"fabric: {'DEGRADED (' + ' '.join(tripped) + ')' if tripped else 'OK'}"
+        f"  enabled={fab['enabled']} links={len(fab['links'])}",
+        f"  census: p50_commit_host_hops={cen['p50_commit_host_hops']}"
+        f" finished={cen['finished']} active={cen['active']}"
+        f" dropped={cen['dropped']}"
+        f" hops={{{' '.join(f'{h}:{n}' for h, n in sorted(cen['hop_counts'].items(), key=lambda kv: int(kv[0])))}}}",
+    ]
+
+    def link_table(title, ranked):
+        if not ranked:
+            return
+        lines.append(f"  {title}:")
+        hdr = ("link", "sent", "recv", "bytes_out", "p50_us", "p99_us")
+        rows = [hdr]
+        for li in ranked[:top_k]:
+            rows.append((f"{li['src']}->{li['dst']}",
+                         str(sum(li["sent"].values())),
+                         str(sum(li["recv"].values())),
+                         str(li["bytes_sent"]),
+                         f"{li['delivery_p50_us']:.0f}",
+                         f"{li['delivery_p99_us']:.0f}"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(hdr))]
+        for r in rows:
+            lines.append("    " + "  ".join(
+                v.ljust(widths[i]) for i, v in enumerate(r)).rstrip())
+
+    link_table("hottest links by bytes sent",
+               sorted(fab["links"], key=lambda li: -li["bytes_sent"]))
+    link_table("hottest links by p99 delivery latency",
+               sorted(fab["links"],
+                      key=lambda li: -li["delivery_p99_us"]))
+    for addr in sorted(fab["hubs"]):
+        hv = fab["hubs"][addr]
+        br = " ".join(f"{p}={s}" for p, s in sorted(hv["breakers"].items()))
+        lines.append(f"  hub {addr}: queued={hv['queue_msgs']}msg"
+                     f"/{hv['queue_bytes']}B  breakers: {br or '-'}")
+    return "\n".join(lines)
+
+
 def render_shard(si: dict) -> str:
     """Human drill-down for a validated NodeHost.shard_info() payload."""
     lines = [
@@ -231,12 +295,22 @@ def main() -> int:
     ap.add_argument("--plan", action="store_true",
                     help="dry-run the control planner over the scraped "
                          "payload; exit 1 when any action is pending")
+    ap.add_argument("--fabric", action="store_true",
+                    help="report /debug/fabric (per-link transport "
+                         "telemetry + hop census); any non-closed "
+                         "breaker exits 1")
+    ap.add_argument("--top", type=int, default=5,
+                    help="K for the --fabric hottest-link tables "
+                         "(default 5)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args()
     if args.plan and args.shard is not None:
         ap.error("--plan reads the whole-host payload; drop --shard")
+    if args.fabric and (args.plan or args.shard is not None):
+        ap.error("--fabric reads /debug/fabric; drop --plan/--shard")
 
-    path = (f"/debug/group/{args.shard}" if args.shard is not None
+    path = ("/debug/fabric" if args.fabric
+            else f"/debug/group/{args.shard}" if args.shard is not None
             else "/debug/groups")
     try:
         obj = fetch_json(args.address, path, args.timeout)
@@ -244,6 +318,20 @@ def main() -> int:
         print(f"error: cannot scrape http://{args.address}{path}: {e}",
               file=sys.stderr)
         return 2
+
+    if args.fabric:
+        from dragonboat_tpu.fabric import validate_fabric
+
+        try:
+            validate_fabric(obj)
+        except ValueError as e:
+            print(f"error: schema validation failed: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(obj, indent=2, sort_keys=True))
+        else:
+            print(render_fabric(obj, args.top))
+        return 1 if _fabric_degraded(obj) else 0
 
     try:
         if args.shard is not None:

@@ -32,6 +32,16 @@ monotone non-negative timestamps per span) before printing; the
 output loads directly in Perfetto (ui.perfetto.dev) or
 chrome://tracing.
 
+``--fabric`` scrapes /debug/fabric — the per-link transport telemetry
++ hop-census snapshot (fabric.py) — strictly validated
+(fabric.validate_fabric), cross-checks the per-class link totals for
+send/recv symmetry, and writes the hop-census baseline artifact
+(``build/fabric_census.json`` by default, ``--out`` to override): the
+``p50_commit_host_hops`` number ROADMAP item 2 must drive to zero,
+paired with PR 17's ``build/transfer_ledger.json`` per-step crossing
+profile when that artifact exists.  Exit 1 on schema or consistency
+failure.
+
 Stdlib-only on the wire (urllib); exit status is non-zero when the
 endpoint is unreachable or the exposition fails strict parsing.
 """
@@ -52,6 +62,41 @@ def fetch(address: str, path: str, timeout: float) -> str:
     url = f"http://{address}{path}"
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read().decode("utf-8")
+
+
+def build_fabric_census(obj: dict) -> dict:
+    """The hop-census baseline artifact from a validated /debug/fabric
+    snapshot: the census block + per-class link totals + send/recv
+    consistency over every link whose BOTH ends are visible in this
+    process (a cross-process link legitimately shows only one side)."""
+    sent_totals: dict = {}
+    recv_totals: dict = {}
+    failures: list[str] = []
+    checked = 0
+    for li in obj["links"]:
+        for cls, n in li["sent"].items():
+            sent_totals[cls] = sent_totals.get(cls, 0) + n
+        for cls, n in li["recv"].items():
+            recv_totals[cls] = recv_totals.get(cls, 0) + n
+        if li["batches_sent"] > 0 and li["batches_recv"] > 0:
+            checked += 1
+            for cls, n in li["recv"].items():
+                if n > li["sent"].get(cls, 0):
+                    failures.append(
+                        f"link {li['src']}->{li['dst']} class {cls}: "
+                        f"recv {n} > sent {li['sent'].get(cls, 0)}")
+    return {
+        "enabled": obj["enabled"],
+        "census": dict(obj["census"]),
+        "p50_commit_host_hops": obj["census"]["p50_commit_host_hops"],
+        "links": [{
+            "src": li["src"], "dst": li["dst"],
+            "bytes_sent": li["bytes_sent"],
+            "delivery_p99_us": li["delivery_p99_us"],
+        } for li in obj["links"]],
+        "class_totals": {"sent": sent_totals, "recv": recv_totals},
+        "consistency": {"checked_links": checked, "failures": failures},
+    }
 
 
 def main() -> int:
@@ -75,6 +120,14 @@ def main() -> int:
                          "headroom, compile counters) instead of /metrics, "
                          "strictly schema-validated; exit 1 on memory "
                          "pressure or retrace storm")
+    ap.add_argument("--fabric", action="store_true",
+                    help="dump /debug/fabric (per-link transport "
+                         "telemetry + hop census) instead of /metrics, "
+                         "strictly schema-validated, and write the "
+                         "hop-census baseline artifact (--out)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path for --fabric (default "
+                         "build/fabric_census.json under the repo root)")
     ap.add_argument("--no-validate", action="store_true",
                     help="skip strict validation (exposition parsing / "
                          "Chrome-trace checks)")
@@ -84,7 +137,8 @@ def main() -> int:
     path = ("/trace" if args.trace
             else "/flight" if args.flight
             else "/debug/groups" if args.doctor
-            else "/debug/capacity" if args.capacity else "/metrics")
+            else "/debug/capacity" if args.capacity
+            else "/debug/fabric" if args.fabric else "/metrics")
     try:
         text = fetch(args.address, path, args.timeout)
     except (urllib.error.URLError, OSError) as e:
@@ -154,6 +208,52 @@ def main() -> int:
                     if obj.get(k)]
         if degraded:
             print(f"degraded: {' '.join(degraded)}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.fabric:
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            print(f"error: /debug/fabric is not valid JSON: {e}",
+                  file=sys.stderr)
+            return 1
+        if not args.no_validate:
+            from dragonboat_tpu.fabric import validate_fabric
+
+            try:
+                n = validate_fabric(obj)
+            except ValueError as e:
+                print(f"error: /debug/fabric schema validation failed: "
+                      f"{e}", file=sys.stderr)
+                return 1
+            print(f"ok: {n} link(s)", file=sys.stderr)
+        artifact = build_fabric_census(obj)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = args.out or os.path.join(root, "build", "fabric_census.json")
+        ledger_path = os.path.join(root, "build", "transfer_ledger.json")
+        if os.path.exists(ledger_path):
+            # pair the host-hop baseline with PR 17's device-crossing
+            # profile: ROADMAP item 2 drives BOTH to zero
+            try:
+                with open(ledger_path, encoding="utf-8") as f:
+                    ledger = json.load(f)
+                artifact["transfer_ledger"] = {
+                    "path": os.path.relpath(ledger_path, root),
+                    "per_step": ledger.get("per_step", {}),
+                }
+            except (OSError, ValueError) as e:
+                print(f"warning: cannot pair {ledger_path}: {e}",
+                      file=sys.stderr)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+        print(json.dumps(artifact, indent=2, sort_keys=True))
+        if not args.no_validate and artifact["consistency"]["failures"]:
+            for msg in artifact["consistency"]["failures"]:
+                print(f"error: consistency: {msg}", file=sys.stderr)
             return 1
         return 0
 

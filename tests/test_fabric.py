@@ -1,0 +1,724 @@
+"""Fabric observability (round 16): header wire codecs, FabricMeter
+units, cross-host trace stitching E2E over BOTH transports, the hop
+census differential, chaos in link telemetry, read-path spans, the
+/debug/fabric endpoint, and the CLI exit matrices.
+
+The meter is process-global (like lifecycle.TRACER), so every test
+snapshots/restores it via the autouse fixture — including the tracer's
+finish/scrub hooks, which unit tests re-point at private meters.
+"""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from dragonboat_tpu import fabric, lifecycle, telemetry
+from dragonboat_tpu import raftpb as pb
+from dragonboat_tpu.config import Config, ExpertConfig, NodeHostConfig
+from dragonboat_tpu.fabric import FabricMeter, validate_fabric
+from dragonboat_tpu.lifecycle import validate_chrome_trace
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.raftpb import gowire as gw
+from dragonboat_tpu.request import LogicalClock, PendingReadIndex
+from dragonboat_tpu.transport.tcp import TCPTransportFactory
+
+from test_kernel_engine import close_all, propose_retry
+from test_lifecycle import make_tracer
+from test_nodehost import KVStateMachine, wait_leader
+from test_tcp_transport import KV, free_ports
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_tracer_and_meter():
+    """Tracer AND meter are process-global; tests also re-point the
+    tracer's census hooks at private meters — always wire them back to
+    the global METER on the way out."""
+    t = lifecycle.TRACER
+    m = fabric.METER
+    t_before = (t._every, t._slow_us)
+    m_before = m.enabled
+    t.reset()
+    m.reset()
+    yield
+    t.configure(sample_every=t_before[0], slow_commit_us=t_before[1])
+    t.reset()
+    t.set_hooks(on_finish=m._census_finish, on_scrub=m._census_drop)
+    m.configure(enabled=m_before)
+    m.reset()
+
+
+def make_meter(**kw):
+    """Fully-isolated meter: injected counting clock + private registry
+    (the GLOBAL ones must not see test samples)."""
+    kw.setdefault("clock", iter(range(0, 10_000_000, 10)).__next__)
+    kw.setdefault("registry", telemetry.Registry())
+    return FabricMeter(**kw)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- header wire codecs ------------------------------------------------------
+
+def _header():
+    return pb.FabricHeader(sent_us=12345, ctxs=(
+        pb.FabricContext(key=7, origin="nh-a", hop=0, shard_id=3),
+        pb.FabricContext(key=9, origin="nh-b:9021", hop=2, shard_id=1),
+    ))
+
+
+def test_fabric_header_blob_roundtrip():
+    h = _header()
+    blob = pb.encode_fabric_header(h)
+    assert pb.decode_fabric_header(blob) == h
+    # unknown version -> None (forward compat: header degrades to
+    # absent in a mixed-version cluster, never to a parse error)
+    newer = pb.encode_fabric_header(
+        pb.FabricHeader(version=pb.FABRIC_WIRE_VERSION + 1, sent_us=1))
+    assert pb.decode_fabric_header(newer) is None
+    # truncation of a KNOWN version is corruption, not skew
+    with pytest.raises(ValueError, match="truncated"):
+        pb.decode_fabric_header(blob[:-1])
+
+
+def test_native_frame_trailer_and_old_frames():
+    msgs = (pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1,
+                       shard_id=3, term=4,
+                       entries=(pb.Entry(index=1, term=4, key=7),)),)
+    # headerless batch: byte format identical to the pre-fabric frame,
+    # decodes with fabric absent
+    plain = pb.MessageBatch(requests=msgs, deployment_id=5,
+                            source_address="nh-a", bin_ver=1)
+    rt0 = pb.decode_message_batch(pb.encode_message_batch(plain))
+    assert rt0.fabric is None and rt0.requests == msgs
+    # header rides the magic-guarded trailer inside the CRC body
+    h = _header()
+    rt1 = pb.decode_message_batch(pb.encode_message_batch(
+        pb.MessageBatch(requests=msgs, deployment_id=5,
+                        source_address="nh-a", bin_ver=1, fabric=h)))
+    assert rt1.fabric == h
+    assert rt1.requests == msgs and rt1.deployment_id == 5
+    # an unknown-version trailer decodes as no-header, not an error
+    rt2 = pb.decode_message_batch(pb.encode_message_batch(
+        pb.MessageBatch(requests=msgs, fabric=pb.FabricHeader(
+            version=pb.FABRIC_WIRE_VERSION + 1))))
+    assert rt2.fabric is None and rt2.requests == msgs
+
+
+def test_gowire_field15_roundtrip_and_old_frame():
+    msgs = (pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1,
+                       shard_id=3, term=4,
+                       entries=(pb.Entry(index=1, term=4, key=7),)),)
+    h = _header()
+    wire = gw.encode_message_batch(msgs, deployment_id=8,
+                                   source_address="nh-a", bin_ver=1,
+                                   fabric=pb.encode_fabric_header(h))
+    reqs, dep, src, ver, fab = gw.decode_message_batch(wire)
+    assert reqs == msgs and dep == 8 and src == "nh-a" and ver == 1
+    assert pb.decode_fabric_header(fab) == h
+    # the reference's decoder treats field 15 as unknown and skips it:
+    # the oracle parse in test_gowire proves that side; here the frame
+    # WITHOUT the field keeps decoding as fabric-absent (old peers)
+    old = gw.encode_message_batch(msgs, deployment_id=8,
+                                  source_address="nh-a", bin_ver=1)
+    assert gw.decode_message_batch(old)[4] is None
+
+
+# -- meter units -------------------------------------------------------------
+
+def test_cross_host_propagation_census_and_remote_spans():
+    t = lifecycle.TRACER
+    t.configure(sample_every=1)
+    m = make_meter()
+    t.set_hooks(on_finish=m._census_finish, on_scrub=m._census_drop)
+    key = 64
+    assert t.begin(key, shard_id=3)
+    rep = pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1,
+                     shard_id=3, term=1,
+                     entries=(pb.Entry(index=1, term=1, key=key),))
+    # origin flush: sampled replicate key becomes an outbound context
+    hdr = m.header_for("nh-a", "nh-b", (rep,))
+    assert hdr is not None and hdr.ctxs == (
+        pb.FabricContext(key=key, origin="nh-a", hop=0, shard_id=3),)
+    m.on_send("nh-a", "nh-b", (rep,), 100, hdr)
+    # remote receive: hub_recv stamp + child span + parked return ctx
+    m.on_batch_received("nh-b", pb.MessageBatch(
+        requests=(rep,), source_address="nh-a", fabric=hdr), nbytes=100)
+    snap = m.snapshot()
+    assert snap["remote_spans"]["active"] == 1
+    # the quorum ack carries the context home with its hop advanced
+    resp = pb.Message(type=pb.MessageType.REPLICATE_RESP, to=1, from_=2,
+                      shard_id=3, term=1)
+    hdr2 = m.header_for("nh-b", "nh-a", (resp,))
+    assert hdr2.ctxs == (
+        pb.FabricContext(key=key, origin="nh-a", hop=1, shard_id=3),)
+    m.on_send("nh-b", "nh-a", (resp,), 40, hdr2)
+    m.on_batch_received("nh-a", pb.MessageBatch(
+        requests=(resp,), source_address="nh-b", fabric=hdr2), nbytes=40)
+    # remote child span retired: remote_recv -> remote_step -> ack_return
+    ev = m.chrome_events()
+    assert [e["name"] for e in ev] == [
+        "remote_recv", "remote_step", "ack_return"]
+    assert all(e["pid"] == fabric.HOST_PID_BASE and e["tid"] == key
+               for e in ev)
+    assert [e["ts"] for e in ev] == sorted(e["ts"] for e in ev)
+    # finish retires the census: 2 crossings, 2 distinct hosts
+    t.finish(key)
+    snap = m.snapshot()
+    assert snap["census"]["finished"] == 1
+    assert snap["census"]["active"] == 0
+    assert snap["census"]["p50_commit_host_hops"] == 2.0
+    assert snap["census"]["hop_counts"] == {"2": 1}
+    assert snap["remote_spans"] == {"active": 0, "retired": 1}
+    # the origin span absorbed the cross-host stamps
+    names = [s for s, _ in t.completed()[-1]["stamps"]]
+    assert lifecycle.STAGE_HUB_RECV in names
+    assert lifecycle.STAGE_ACK_RETURN in names
+    assert validate_fabric(snap) == 2
+
+
+def test_link_tallies_classes_and_delivery():
+    clock = iter(range(0, 10_000_000, 10)).__next__
+    m = make_meter(clock=clock)
+    msgs = (
+        pb.Message(type=pb.MessageType.REQUEST_VOTE, to=2, from_=1),
+        pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1),
+        pb.Message(type=pb.MessageType.HEARTBEAT, to=2, from_=1),
+        pb.Message(type=pb.MessageType.READ_INDEX, to=2, from_=1),
+        pb.Message(type=pb.MessageType.LOCAL_TICK, to=2, from_=1),
+    )
+    m.on_send("nh-a", "nh-b", msgs, 500)
+    m.on_chunk_sent("nh-a", "nh-b", 4096)
+    m.on_batch_received("nh-b", pb.MessageBatch(
+        requests=msgs[:2], source_address="nh-a",
+        fabric=pb.FabricHeader(sent_us=0)), nbytes=200)
+    snap = m.snapshot()
+    (li,) = snap["links"]
+    assert (li["src"], li["dst"]) == ("nh-a", "nh-b")
+    assert li["sent"] == {"request_vote": 1, "append": 1, "heartbeat": 1,
+                          "read_index": 1, "snapshot_chunk": 1, "other": 1}
+    assert li["recv"]["request_vote"] == 1 and li["recv"]["append"] == 1
+    assert li["bytes_sent"] == 500 + 4096 and li["bytes_recv"] == 200
+    assert li["batches_sent"] == 1 and li["batches_recv"] == 1
+    # delivery latency off the header's sender stamp and OUR clock
+    assert li["delivery_count"] == 1 and li["delivery_p50_us"] >= 0
+    assert validate_fabric(snap) == 1
+
+
+def test_disabled_meter_is_noop_and_scrub_drops_census():
+    t = lifecycle.TRACER
+    t.configure(sample_every=1)
+    off = make_meter(enabled=False)
+    rep = pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1,
+                     entries=(pb.Entry(index=1, term=1, key=64),))
+    assert t.begin(64)
+    assert off.header_for("nh-a", "nh-b", (rep,)) is None
+    off.on_send("nh-a", "nh-b", (rep,), 100)
+    off.on_batch_received("nh-b", pb.MessageBatch(
+        requests=(rep,), source_address="nh-a"))
+    snap = off.snapshot()
+    assert snap["enabled"] is False and snap["links"] == []
+    t.scrub(64)
+
+    # scrub hook: a census entry for a dead span is dropped, not hung
+    m = make_meter()
+    t.set_hooks(on_finish=m._census_finish, on_scrub=m._census_drop)
+    assert t.begin(128)
+    hdr = m.header_for("nh-a", "nh-b", (pb.Message(
+        type=pb.MessageType.REPLICATE, to=2, from_=1,
+        entries=(pb.Entry(index=1, term=1, key=128),)),))
+    m.on_send("nh-a", "nh-b", (), 0, hdr)
+    assert m.snapshot()["census"]["active"] == 1
+    t.scrub(128)
+    cen = m.snapshot()["census"]
+    assert cen == {"active": 0, "finished": 0, "dropped": 1,
+                   "p50_commit_host_hops": 0.0, "hop_counts": {}}
+
+
+def test_validate_fabric_rejections():
+    m = make_meter()
+    m.on_send("nh-a", "nh-b", (pb.Message(
+        type=pb.MessageType.HEARTBEAT, to=2, from_=1),), 64)
+    ok = m.snapshot()
+    assert validate_fabric(ok) == 1
+    with pytest.raises(ValueError, match="must be an object"):
+        validate_fabric([])
+    for missing in ("enabled", "links", "census", "remote_spans", "hubs"):
+        bad = dict(ok)
+        del bad[missing]
+        with pytest.raises(ValueError, match=missing):
+            validate_fabric(bad)
+    bad = json.loads(json.dumps(ok))
+    bad["links"][0]["sent"]["warp"] = 1
+    with pytest.raises(ValueError, match="unknown message class"):
+        validate_fabric(bad)
+    bad = json.loads(json.dumps(ok))
+    bad["links"][0]["bytes_sent"] = -1
+    with pytest.raises(ValueError, match="non-negative"):
+        validate_fabric(bad)
+    bad = json.loads(json.dumps(ok))
+    bad["census"]["hop_counts"] = {"x": 1}
+    with pytest.raises(ValueError, match="digit string"):
+        validate_fabric(bad)
+    bad = json.loads(json.dumps(ok))
+    bad["hubs"]["nh-a"] = {"queue_msgs": 0, "queue_bytes": 0,
+                           "breakers": {"nh-b": "melted"}}
+    with pytest.raises(ValueError, match="unknown.*state"):
+        validate_fabric(bad)
+
+
+# -- read-path lifecycle spans (satellite 1) ---------------------------------
+
+def test_read_span_stages_and_histogram_labels():
+    reg = telemetry.Registry()
+    t = make_tracer(registry=reg)
+    assert t.begin_read(5, shard_id=2)
+    t.stamp(5, lifecycle.STAGE_READ_QUORUM)
+    t.finish(5)
+    (tr,) = t.completed()
+    assert tr["kind"] == lifecycle.KIND_READ and tr["shard_id"] == 2
+    assert [s for s, _ in tr["stamps"]] == [
+        "read_propose", "read_quorum", "read_serve"]
+    fams = telemetry.parse_exposition(reg.exposition())
+    by_label = {lb.get("stage"): v
+                for nm, lb, v in fams["commit_stage_us"]["samples"]
+                if nm.endswith("_count")}
+    assert by_label == {"read_quorum": 1, "read_serve": 1,
+                        "read_total": 1}
+
+
+def test_read_book_traces_quorum_to_serve_and_scrubs():
+    t = lifecycle.TRACER
+    t.configure(sample_every=1)
+    book = PendingReadIndex(clock=LogicalClock(), shard_id=4)
+    rs = book.read(timeout_ticks=100)
+    assert t.active_count() == 1
+    ctx = book.peep()
+    book.add_ready(ctx, 5)
+    book.applied(5)
+    assert rs.wait(1).completed()
+    tr = t.completed()[-1]
+    assert tr["kind"] == lifecycle.KIND_READ and tr["key"] == rs.key
+    assert [s for s, _ in tr["stamps"]] == [
+        "read_propose", "read_quorum", "read_serve"]
+    # removal verbs scrub, never trace
+    book.read(timeout_ticks=100)
+    book.terminate_all()
+    assert t.active_count() == 0 and t.counts()["scrubbed"] == 1
+
+
+# -- E2E: stitched cross-host traces over both transports --------------------
+
+def _chan_cluster(prefix, depth):
+    addrs = {i: f"{prefix}-{i}" for i in range(1, 4)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(
+            raft_address=addr, rtt_millisecond=5,
+            expert=ExpertConfig(kernel_log_cap=256, kernel_capacity=8,
+                                kernel_apply_batch=16,
+                                kernel_compaction_overhead=16,
+                                kernel_pipeline_depth=depth,
+                                trace_sample_every=1)))
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=2,
+            compaction_overhead=5, device_resident=True))
+        hosts[rid] = nh
+    return hosts
+
+
+def _tcp_cluster(wire):
+    ports = free_ports(3)
+    addrs = {i: f"127.0.0.1:{ports[i - 1]}" for i in range(1, 4)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(
+            raft_address=addr, rtt_millisecond=5,
+            transport_factory=TCPTransportFactory(wire=wire),
+            expert=ExpertConfig(trace_sample_every=1)))
+        nh.start_replica(addrs, False, KV, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=1,
+            compaction_overhead=2))
+        hosts[rid] = nh
+    return hosts
+
+
+def _wait_fabric(pred, timeout=30):
+    deadline = time.time() + timeout
+    snap = None
+    while time.time() < deadline:
+        snap = fabric.METER.snapshot()
+        if pred(snap):
+            return snap
+        time.sleep(0.1)
+    raise AssertionError(f"fabric condition never met; last census="
+                         f"{snap and snap['census']} remote="
+                         f"{snap and snap['remote_spans']}")
+
+
+def _assert_stitched_trace(min_hosts=2):
+    """The acceptance check: the merged lifecycle + fabric export is one
+    valid Chrome trace with remote child spans from >= min_hosts hosts
+    sharing tids with the origin's lifecycle spans."""
+    events = fabric.METER.chrome_events()
+    pids = {e["pid"] for e in events}
+    assert len(pids) >= min_hosts, f"remote spans from {pids} only"
+    assert all(p >= fabric.HOST_PID_BASE for p in pids)
+    merged = lifecycle.TRACER.export_chrome_trace()
+    lc_tids = {e["tid"] for e in merged["traceEvents"]}
+    merged["traceEvents"] = merged["traceEvents"] + events
+    obj = json.loads(json.dumps(merged))
+    assert validate_chrome_trace(obj) == len(merged["traceEvents"])
+    # stitching: a remote span rides the SAME tid as its origin span
+    assert any(e["tid"] in lc_tids for e in events), \
+        "no remote span shares a tid with a lifecycle span"
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["serial", "pipelined"])
+def test_e2e_stitched_trace_chan(depth):
+    hosts = _chan_cluster(f"fab{depth}", depth)
+    try:
+        assert fabric.METER.enabled    # NodeHost wired the expert knob
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        for i in range(8):
+            propose_retry(nh, sess, f"f{i}=v{i}".encode())
+        snap = _wait_fabric(
+            lambda s: s["remote_spans"]["retired"] >= 2
+            and s["census"]["finished"] >= 1
+            and len({e["pid"]
+                     for e in fabric.METER.chrome_events()}) >= 2)
+        _assert_stitched_trace(min_hosts=2)
+        # a full cross-host span: hub_send at the origin, hub_recv on
+        # the remote (the PR 7 fix), the quorum ack returning home
+        deadline = time.time() + 20
+        want = {lifecycle.STAGE_HUB_SEND, lifecycle.STAGE_HUB_RECV,
+                lifecycle.STAGE_ACK_RETURN}
+        while time.time() < deadline:
+            if any(want <= {s for s, _ in tr["stamps"]}
+                   for tr in lifecycle.TRACER.completed()):
+                break
+            propose_retry(nh, sess, b"more=1")
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no trace crossed hub_send/hub_recv/"
+                                 "ack_return")
+        # census: every quorum round hops >= 2 (out and back)
+        assert snap["census"]["p50_commit_host_hops"] >= 2.0
+        # the snapshot rides NodeHost.info() and validates strictly
+        assert validate_fabric(nh.info()["fabric"]) >= 2
+        # both directions of at least one link carry append traffic
+        by_pair = {(li["src"], li["dst"]): li for li in snap["links"]}
+        assert any((d, s) in by_pair and li["sent"]["append"] > 0
+                   for (s, d), li in by_pair.items())
+        # read path: a served read completes a read-kind span
+        assert nh.sync_read(1, "f0") == "v0"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if any(tr.get("kind") == lifecycle.KIND_READ
+                   for tr in lifecycle.TRACER.completed()):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no completed read span")
+    finally:
+        close_all(hosts)
+
+
+@pytest.mark.parametrize("wire", ["native", "go"])
+def test_e2e_stitched_trace_tcp(wire):
+    """The header survives real sockets on BOTH wire formats: the
+    native frame's magic trailer and the go-wire protobuf field 15."""
+    hosts = _tcp_cluster(wire)
+    try:
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        for i in range(8):
+            propose_retry(nh, sess, f"t{i}=v{i}".encode())
+        _wait_fabric(
+            lambda s: s["remote_spans"]["retired"] >= 2
+            and s["census"]["finished"] >= 1
+            and len({e["pid"]
+                     for e in fabric.METER.chrome_events()}) >= 2)
+        _assert_stitched_trace(min_hosts=2)
+        snap = fabric.METER.snapshot()
+        assert snap["census"]["p50_commit_host_hops"] >= 2.0
+        # delivery latency is measurable over real sockets
+        assert any(li["delivery_count"] > 0 for li in snap["links"])
+    finally:
+        close_all(hosts)
+
+
+# -- hop-census differential -------------------------------------------------
+
+def test_hop_census_matches_pure_python_recount(monkeypatch):
+    """The meter's hop histogram must equal an independent recount of
+    header crossings observed at the send seam."""
+    crossings = {}
+    finished = []
+    orig_send = fabric.METER.on_send
+
+    def spy_send(src, dst, msgs, nbytes, header=None):
+        if header is not None:
+            for c in header.ctxs:
+                crossings[c.key] = crossings.get(c.key, 0) + 1
+        orig_send(src, dst, msgs, nbytes, header)
+
+    def spy_finish(key, kind):
+        fabric.METER._census_finish(key, kind)
+        if kind == lifecycle.KIND_PROPOSAL:
+            finished.append(key)
+
+    monkeypatch.setattr(fabric.METER, "on_send", spy_send)
+    lifecycle.TRACER.set_hooks(on_finish=spy_finish,
+                               on_scrub=fabric.METER._census_drop)
+    hosts = _chan_cluster("fabcensus", 0)
+    try:
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        for i in range(10):
+            propose_retry(nh, sess, f"c{i}=v{i}".encode())
+        _wait_fabric(lambda s: s["census"]["finished"] >= 5)
+    finally:
+        close_all(hosts)
+    with fabric.METER.mu:
+        hops_done = list(fabric.METER._hops_done)
+    recount = sorted(crossings[k] for k in finished if k in crossings)
+    assert len(hops_done) == len(finished)
+    assert sorted(hops_done) == recount, (hops_done, recount)
+    assert all(h >= 2 for h in recount)   # out + quorum ack, minimum
+
+
+# -- chaos: partitions and delays land in the link telemetry -----------------
+
+def test_chaos_delay_and_breaker_in_link_telemetry():
+    hosts = _chan_cluster("fabchaos", 0)
+    try:
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        followers = [r for r in hosts if r != lead]
+        slow = followers[0]
+        lead_addr = nh.config.raft_address
+        slow_addr = hosts[slow].config.raft_address
+        # 30ms delivery delay into one follower (receiver-side hook,
+        # under the 50ms election timeout): its link's latency
+        # histogram must move while the other follower's stays put
+        hosts[slow].transport.delay_func = lambda m: 0.03
+        sess = nh.get_noop_session(1)
+        for i in range(10):
+            propose_retry(nh, sess, f"d{i}=v{i}".encode())
+
+        def delayed_visible(s):
+            li = next((li for li in s["links"]
+                       if (li["src"], li["dst"]) ==
+                       (lead_addr, slow_addr)), None)
+            return (li is not None and li["delivery_count"] >= 3
+                    and li["delivery_p50_us"] >= 20_000)
+        snap = _wait_fabric(delayed_visible)
+        fast_addr = hosts[followers[1]].config.raft_address
+        fast = next((li for li in snap["links"]
+                     if (li["src"], li["dst"]) == (lead_addr, fast_addr)),
+                    None)
+        if fast is not None and fast["delivery_count"] >= 3:
+            assert fast["delivery_p50_us"] < 20_000
+        hosts[slow].transport.delay_func = None
+
+        # kill the other follower's listener: the leader's breaker for
+        # it must trip, and the snapshot must report it as non-closed
+        dead = followers[1]
+        hosts[dead].transport.close()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            for i in range(3):
+                try:
+                    propose_retry(nh, sess, b"p=1", timeout_s=5)
+                except Exception:
+                    pass
+            snap = fabric.METER.snapshot()
+            hub = snap["hubs"].get(lead_addr, {"breakers": {}})
+            if hub["breakers"].get(fast_addr, "closed") != "closed":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"breaker never tripped: {snap['hubs']}")
+        assert validate_fabric(snap) >= 2
+        # the doctor's degradation rule sees exactly this
+        fd = _load_script("fleet_doctor")
+        assert fd._fabric_degraded(snap)
+        assert "DEGRADED" in fd.render_fabric(snap)
+    finally:
+        close_all(hosts)
+
+
+# -- /debug/fabric endpoint --------------------------------------------------
+
+def test_debug_fabric_endpoint_and_merged_trace():
+    from dragonboat_tpu.server.metrics_http import MetricsServer
+
+    t = lifecycle.TRACER
+    t.configure(sample_every=1)
+    m = make_meter()
+    t.set_hooks(on_finish=m._census_finish, on_scrub=m._census_drop)
+    key = 64
+    assert t.begin(key, shard_id=1)
+    rep = pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1,
+                     shard_id=1, entries=(pb.Entry(index=1, term=1,
+                                                   key=key),))
+    hdr = m.header_for("nh-a", "nh-b", (rep,))
+    m.on_send("nh-a", "nh-b", (rep,), 80, hdr)
+    m.on_batch_received("nh-b", pb.MessageBatch(
+        requests=(rep,), source_address="nh-a", fabric=hdr), nbytes=80)
+    resp = pb.Message(type=pb.MessageType.REPLICATE_RESP, to=1, from_=2,
+                      shard_id=1)
+    hdr2 = m.header_for("nh-b", "nh-a", (resp,))
+    m.on_send("nh-b", "nh-a", (resp,), 30, hdr2)
+    m.on_batch_received("nh-a", pb.MessageBatch(
+        requests=(resp,), source_address="nh-b", fabric=hdr2), nbytes=30)
+    t.finish(key)
+    srv = MetricsServer([telemetry.Registry()], tracer=t,
+                        fabric_source=m.snapshot,
+                        fabric_trace_source=m.chrome_events)
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.address}/debug/fabric", timeout=5) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            obj = json.loads(resp.read().decode("utf-8"))
+        assert validate_fabric(obj) == 2
+        assert obj["census"]["finished"] == 1
+        # /trace merges the remote child spans beside lifecycle spans
+        with urllib.request.urlopen(
+                f"http://{srv.address}/trace", timeout=5) as resp:
+            trace = json.loads(resp.read().decode("utf-8"))
+        assert validate_chrome_trace(trace) > 0
+        cats = {e.get("cat") for e in trace["traceEvents"]}
+        assert "fabric" in cats
+        fab_pids = {e["pid"] for e in trace["traceEvents"]
+                    if e.get("cat") == "fabric"}
+        assert all(p >= fabric.HOST_PID_BASE for p in fab_pids)
+    finally:
+        srv.close()
+
+
+# -- CLI exit matrices (satellite 3) -----------------------------------------
+
+def _meter_snapshot(tripped=False, inconsistent=False):
+    """A small real snapshot via a private meter; optionally doctored
+    AFTER the fact (the meter itself cannot produce these states)."""
+    m = make_meter()
+    msgs = (pb.Message(type=pb.MessageType.REPLICATE, to=2, from_=1),)
+    m.on_send("nh-a", "nh-b", msgs, 100)
+    m.on_batch_received("nh-b", pb.MessageBatch(
+        requests=msgs, source_address="nh-a"), nbytes=100)
+    snap = m.snapshot()
+    if tripped:
+        snap["hubs"]["nh-a"] = {"queue_msgs": 3, "queue_bytes": 300,
+                                "breakers": {"nh-b": "open"}}
+    if inconsistent:
+        li = snap["links"][0]
+        li["recv"] = dict(li["recv"], append=li["sent"]["append"] + 5)
+    return snap
+
+
+def test_metrics_dump_and_fleet_doctor_fabric_matrix(capsys, tmp_path):
+    import sys
+
+    from dragonboat_tpu.server.metrics_http import MetricsServer
+
+    md = _load_script("metrics_dump")
+    fd = _load_script("fleet_doctor")
+    state = {"fab": _meter_snapshot()}
+    srv = MetricsServer([telemetry.Registry()],
+                        fabric_source=lambda: state["fab"])
+    argv = sys.argv
+    out_path = str(tmp_path / "fabric_census.json")
+    try:
+        # healthy: dump validates, writes the artifact, exits 0
+        sys.argv = ["metrics_dump.py", srv.address, "--fabric",
+                    "--out", out_path]
+        assert md.main() == 0
+        out = capsys.readouterr()
+        assert "ok: 1 link(s)" in out.err
+        artifact = json.loads(out.out)
+        assert artifact["class_totals"]["sent"]["append"] == 1
+        assert artifact["consistency"]["failures"] == []
+        with open(out_path, encoding="utf-8") as f:
+            assert json.load(f) == artifact
+        # doctor renders and exits 0
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric"]
+        assert fd.main() == 0
+        out = capsys.readouterr().out
+        assert "fabric: OK" in out and "hottest links" in out
+        # --json round-trips the payload verbatim
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric", "--json"]
+        assert fd.main() == 0
+        assert json.loads(capsys.readouterr().out) == state["fab"]
+        # tripped breaker: doctor degrades (exit 1)
+        state["fab"] = _meter_snapshot(tripped=True)
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric"]
+        assert fd.main() == 1
+        assert "DEGRADED" in capsys.readouterr().out
+        # send/recv inconsistency on a both-ends-visible link: dump
+        # exits 1 and names the class
+        state["fab"] = _meter_snapshot(inconsistent=True)
+        sys.argv = ["metrics_dump.py", srv.address, "--fabric",
+                    "--out", out_path]
+        assert md.main() == 1
+        assert "consistency" in capsys.readouterr().err
+        # schema drift: dump 1, doctor 2
+        state["fab"] = dict(_meter_snapshot(), surprise=1)
+        del state["fab"]["census"]
+        sys.argv = ["metrics_dump.py", srv.address, "--fabric"]
+        assert md.main() == 1
+        assert "schema validation failed" in capsys.readouterr().err
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric"]
+        assert fd.main() == 2
+        capsys.readouterr()
+        # flag conflicts are argparse errors
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric",
+                    "--shard", "1"]
+        with pytest.raises(SystemExit):
+            fd.main()
+        capsys.readouterr()
+    finally:
+        sys.argv = argv
+        srv.close()
+    # unreachable endpoint: both exit 2
+    sys.argv = ["metrics_dump.py", srv.address, "--fabric"]
+    try:
+        assert md.main() == 2
+        sys.argv = ["fleet_doctor.py", srv.address, "--fabric"]
+        assert fd.main() == 2
+    finally:
+        sys.argv = argv
+    capsys.readouterr()
+
+
+def test_build_fabric_census_pairs_transfer_ledger(tmp_path):
+    md = _load_script("metrics_dump")
+    snap = _meter_snapshot()
+    artifact = md.build_fabric_census(snap)
+    assert artifact["p50_commit_host_hops"] == \
+        snap["census"]["p50_commit_host_hops"]
+    assert artifact["consistency"]["checked_links"] == 1
+    # a one-sided link (cross-process peer) is exempt from the check
+    one_sided = _meter_snapshot()
+    one_sided["links"][0]["batches_recv"] = 0
+    one_sided["links"][0]["recv"] = dict.fromkeys(
+        fabric.MESSAGE_CLASSES, 0)
+    a2 = md.build_fabric_census(one_sided)
+    assert a2["consistency"]["checked_links"] == 0
+    assert a2["consistency"]["failures"] == []

@@ -194,9 +194,10 @@ def test_oracle_roundtrip_batch_and_snapshot():
     assert parsed.bin_ver == 1
     assert parsed.requests[2].shard_id == 2
     # and back through gowire
-    reqs, dep, src, ver = gw.decode_message_batch(
+    reqs, dep, src, ver, fab = gw.decode_message_batch(
         parsed.SerializeToString())
     assert len(reqs) == 4 and dep == 77 and src == "nh:900" and ver == 1
+    assert fab is None  # oracle frame carries no fabric header
     assert reqs[3].log_index == 21
 
     s = pb.Snapshot(filepath="/x/y", file_size=10, index=9, term=2,
