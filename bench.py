@@ -69,7 +69,12 @@ capacity.METER tag counters + scoped jax.transfer_guard around the
 dispatch seam — see run_transfer_ab), BENCH_ELASTIC=1 (standalone
 mode: the elastic control plane's two closing numbers — skew-vs-uniform
 acked throughput with the fleet controller on, and the masked-quiesce
-step-time reduction at 90% cold — see run_elastic_ab).
+step-time reduction at 90% cold — see run_elastic_ab),
+BENCH_FABRIC_RESIDENT=1 (standalone mode: the round-17 tentpole's A-B
+— co-located consensus over the in-step collective vs round-tripped
+through the host hub's route() staging, on the serving loop, with
+compile telemetry pinning compiles=1/retraces=0 on the resident entry
+— see run_fabric_resident_ab).
 """
 
 import json
@@ -2290,11 +2295,10 @@ def run_mesh_pipeline_ab() -> None:
     """BENCH_MESH_PIPELINE=1: A-B of the MESH dispatch path's two jit
     entries (engine/dispatch.py MeshDispatch) under the same host
     protocol the engine runs — serial depth-0 (non-donated
-    jit_serve_step, blocking per-step fetch) vs pipelined depth-1
+    jit_serve_step, blocking per-step staging) vs pipelined depth-1
     (jit_serve_step_donated: buffers donated to XLA, host staging
-    built from one-step-stale retired copies, the pending scalar
-    consumed one step late) — at 1024 groups x 3 replicas on a
-    ('g','r') = (1, 3) host mesh.
+    built from one-step-stale retired copies) — at 1024 groups x 3
+    replicas on a ('g','r') = (1, 3) host mesh.
 
     Interleaved windows A,B,A,B,... (median-of-3 per arm, the headline
     bench's policy); each arm reports wall, per-micro-step time and
@@ -2330,7 +2334,8 @@ def run_mesh_pipeline_ab() -> None:
     mesh = Mesh(np.array(devs[:replicas]).reshape(1, replicas),
                 ("g", "r"))
     cluster, state0, box0 = make_ici_cluster(kp, mesh, groups)
-    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+    cut = cluster.shard(
+        np.zeros((cluster.total_rows, kp.num_peers), bool))
 
     def host_input(role_h, proc_h, propose=True):
         # the engine's _InputBuilder shape: staged from HOST copies, so
@@ -2356,7 +2361,7 @@ def run_mesh_pipeline_ab() -> None:
             break
         inp = cluster.shard(host_input(
             role_h, np.asarray(state.processed), propose=False))
-        state, box, _, _ = jit_serve_step(
+        state, box, _ = jit_serve_step(
             kp, cluster, state, box, inp, cut)
     lead_rows = np.asarray(state.role) == KP.LEADER
 
@@ -2373,35 +2378,29 @@ def run_mesh_pipeline_ab() -> None:
         t0 = time.time()
         if arm == "serial":
             # depth-0 protocol: stage from the CURRENT state (blocking
-            # host fetch), dispatch the non-donated oracle, consume the
-            # pending scalar immediately (the per-step blocking fetch)
+            # host fetch), dispatch the non-donated oracle
             for _ in range(micro):
                 inp = cluster.shard(host_input(
                     np.asarray(a["state"].role),
                     np.asarray(a["state"].processed)))
-                a["state"], a["box"], _, pending = jit_serve_step(
+                a["state"], a["box"], _ = jit_serve_step(
                     kp, cluster, a["state"], a["box"], inp, cut)
-                int(pending)
         else:
             # depth-1 protocol: stage from one-step-stale retired
             # copies (host build overlaps the in-flight device step),
             # pull the NEXT staging copies right before dispatch hands
-            # the buffers to XLA, defer the pending sync one step.
+            # the buffers to XLA.
             # np.array (a real copy), never np.asarray: on CPU that is
             # a zero-copy view of a buffer this arm donates away
             role_h = np.array(a["state"].role)
             proc_h = np.array(a["state"].processed)
-            pending_carry = None
             for _ in range(micro):
                 inp = cluster.shard(host_input(role_h, proc_h))
-                if pending_carry is not None:
-                    int(pending_carry)
                 role_h = np.array(a["state"].role)
                 proc_h = np.array(a["state"].processed)
-                a["state"], a["box"], _, pending_carry = \
+                a["state"], a["box"], _ = \
                     jit_serve_step_donated(
                         kp, cluster, a["state"], a["box"], inp, cut)
-            int(pending_carry)
         a["state"].term.block_until_ready()
         dt = time.time() - t0
         w = committed(a["state"]) - c0
@@ -2439,7 +2438,184 @@ def run_mesh_pipeline_ab() -> None:
     })
 
 
+def run_fabric_resident_ab() -> None:
+    """BENCH_FABRIC_RESIDENT=1: the round-17 tentpole's closing number
+    — co-located consensus traffic over the interconnect vs through the
+    host hub, on the SERVING loop (parallel/ici.py jit_serve_step).
+
+    Arm A (resident) serves with an all-open per-link cut mask:
+    messages ride the in-step collective and the host stages nothing
+    but StepInput.  Arm B (hub) serves with EVERY link cut — the step
+    emits but exchanges nothing on the mesh; its out-lanes are pulled
+    to the host, staged through core/router.route (the hub fallback's
+    slot addressing) and re-uploaded as the next inbox, which is
+    exactly what every co-located message paid before round 17.  Arms
+    interleave A,B,A,B,... (median-of-3 per arm); the resident entry
+    runs under a CompileTracker wrapper and must show compiles=1 /
+    retraces=0 across pump + warm + all windows.  Knobs:
+    BENCH_FABRIC_RESIDENT_GROUPS (default 1024),
+    BENCH_FABRIC_RESIDENT_STEPS (micro-steps per window, default
+    120)."""
+    import numpy as np
+
+    import jax
+
+    from dragonboat_tpu import capacity
+    from dragonboat_tpu.bench_loop import bench_params
+    from dragonboat_tpu.core import params as KP
+    from dragonboat_tpu.core.router import route
+    from dragonboat_tpu.parallel.ici import (
+        jit_serve_step,
+        make_ici_cluster,
+        self_driving_input,
+    )
+    from jax.sharding import Mesh
+
+    replicas = 3
+    devs = jax.devices()
+    if len(devs) < replicas:
+        raise RuntimeError(
+            f"fabric A/B needs {replicas} host devices, have {len(devs)} "
+            "(main() forces xla_force_host_platform_device_count "
+            "before jax loads — do not preimport jax)")
+    groups = int(os.environ.get("BENCH_FABRIC_RESIDENT_GROUPS", "1024"))
+    micro = int(os.environ.get("BENCH_FABRIC_RESIDENT_STEPS", "120"))
+    platform = devs[0].platform
+    kp = bench_params(replicas)
+    mesh = Mesh(np.array(devs[:replicas]).reshape(1, replicas),
+                ("g", "r"))
+    cluster, state, box = make_ici_cluster(kp, mesh, groups)
+    # g_size=1 layout: router row n*R+ir lives at mesh row ir*groups+n
+    perm = np.empty(groups * replicas, np.int64)
+    for n in range(groups):
+        for ir in range(replicas):
+            perm[n * replicas + ir] = ir * groups + n
+    iperm = np.argsort(perm)
+    total = cluster.total_rows
+    cut_open = cluster.shard(
+        np.zeros((total, kp.num_peers), bool))
+    cut_all = cluster.shard(
+        np.ones((total, kp.num_peers), bool))
+
+    # prime the startup-only signature: the very first call sees the
+    # fresh device_put arrays from make_ici_cluster, whose committed
+    # layouts differ from every later jit-output step — a one-time
+    # second lowering that exists at any engine's startup, not a
+    # retrace the serving loop can hit
+    inp = self_driving_input(kp, state, propose=False)
+    state, box, _ = jit_serve_step(kp, cluster, state, box, inp,
+                                   cut_open)
+
+    # the resident entry under compile telemetry: the acceptance gate
+    # is ONE compile (the steady-state signature) and ZERO retraces
+    # across pump + warm + every window — cut is a traced argument, so
+    # flipping the mask must not re-lower the executable
+    tracker = capacity.CompileTracker()
+    serve_resident = tracker.wrap("fabric_resident_serve",
+                                  jit_serve_step)
+
+    # election pump (resident path) until every group has one leader
+    for _ in range(40):
+        if int((np.asarray(state.role) == KP.LEADER).sum()) >= groups:
+            break
+        inp = self_driving_input(kp, state, propose=False)
+        state, box, _ = serve_resident(
+            kp, cluster, state, box, inp, cut_open)
+    lead_rows = np.asarray(state.role) == KP.LEADER
+
+    route_jit = jax.jit(route, static_argnums=(0, 1))
+    pull = lambda t: jax.tree.map(  # noqa: E731
+        lambda x: np.array(x), t)
+    repermute = lambda t, p: jax.tree.map(  # noqa: E731
+        lambda x: x[p], t)
+
+    def committed(st):
+        return int(np.asarray(st.committed)[lead_rows]
+                   .astype(np.int64).sum())
+
+    arms = {"resident": {"state": state, "box": box},
+            "hub": {"state": state, "box": box}}
+
+    def window(arm):
+        a = arms[arm]
+        c0 = committed(a["state"])
+        t0 = time.time()
+        for _ in range(micro):
+            inp = self_driving_input(kp, a["state"], propose=True)
+            if arm == "resident":
+                a["state"], a["box"], _ = serve_resident(
+                    kp, cluster, a["state"], a["box"], inp, cut_open)
+            else:
+                # hub delivery: the mesh exchanges nothing (every link
+                # cut); out-lanes round-trip the host through route()
+                a["state"], _, outgoing = jit_serve_step(
+                    kp, cluster, a["state"], a["box"], inp, cut_all)
+                hub_box = route_jit(
+                    kp, replicas, repermute(pull(outgoing), perm))
+                a["box"] = cluster.shard(repermute(pull(hub_box), iperm))
+        a["state"].term.block_until_ready()
+        dt = time.time() - t0
+        w = committed(a["state"]) - c0
+        return {"wall_s": round(dt, 3),
+                "micro_step_ms": round(dt / micro * 1e3, 3),
+                "writes": w,
+                "writes_per_s": round(w / dt)}
+
+    for arm in arms:  # warm both executables outside the timed windows
+        window(arm)
+    wins = {"resident": [], "hub": []}
+    for _ in range(3):
+        for arm in ("resident", "hub"):
+            wins[arm].append(window(arm))
+    med = {arm: sorted(ws, key=lambda r: r["micro_step_ms"])[1]
+           for arm, ws in wins.items()}
+    speedup = (med["hub"]["micro_step_ms"]
+               / max(med["resident"]["micro_step_ms"], 1e-9))
+    ct = serve_resident.stats()
+    if ct["compiles"] != 1 or ct["retraces"] != 0:
+        raise RuntimeError(
+            f"resident serve entry re-lowered: {ct} (cut-mask flips or "
+            "input staging changed the traced signature)")
+    emit({
+        "metric": ("device-resident fabric vs host-hub delivery, "
+                   f"{groups} groups x {replicas} replicas, "
+                   "serving loop"),
+        "value": round(speedup, 3),
+        "unit": "x hub/resident micro-step time",
+        "vs_baseline": 0.0,
+        "detail": {
+            "platform": platform,
+            "mesh": f"('g','r') = (1, {replicas})",
+            "groups": groups,
+            "micro_steps_per_window": micro,
+            "resident": med["resident"],
+            "hub": med["hub"],
+            "windows": wins,
+            "resident_compile": {"calls": ct["calls"],
+                                 "compiles": ct["compiles"],
+                                 "retraces": ct["retraces"]},
+            "policy": "median-of-3 interleaved windows per arm",
+        },
+    })
+
+
 def main() -> None:
+    if os.environ.get("BENCH_FABRIC_RESIDENT") == "1":
+        # must run before anything imports jax: the 3-replica mesh
+        # needs one host device per replica slot
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=3"
+            ).strip()
+        try:
+            run_fabric_resident_ab()
+        except Exception:
+            import traceback
+
+            fail("fabric-resident-ab", traceback.format_exc())
+        return
     if os.environ.get("BENCH_MESH_PIPELINE") == "1":
         # must run before anything imports jax: the 3-replica mesh
         # needs one host device per replica slot

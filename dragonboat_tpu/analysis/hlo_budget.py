@@ -144,7 +144,8 @@ def measure_mesh(groups: int = 4, replicas: int = 2,
                     ("g", "r"))
         cluster, state, box = ici.make_ici_cluster(kp, mesh, groups)
         inp = cluster.shard(ici.self_driving_input(kp, state))
-        cut = cluster.shard(jnp.zeros((cluster.total_rows,), bool))
+        cut = cluster.shard(
+            jnp.zeros((cluster.total_rows, kp.num_peers), bool))
     with tracing.annotate("lint.hlo.lower"):
         lowered = ici.jit_serve_step.lower(
             kp, cluster, state, box, inp, cut)

@@ -34,6 +34,7 @@ from dragonboat_tpu.config import (
     Config,
     ExpertConfig,
     LogDBConfig,
+    MeshSpec,
     NodeHostConfig,
 )
 from dragonboat_tpu.nodehost import NodeHost
@@ -106,6 +107,11 @@ class _Cluster:
     # then exercises crash/restart with a donated step in flight
     device_resident: bool = False
     pipeline_depth: int = 0
+    # run shards as rows of the shared MESH engine (one replica per
+    # device along axis 'r'): partition/delay/drop faults then drive the
+    # round-17 per-link cut masks and hub fallback instead of the chan
+    # transport alone
+    mesh_resident: bool = False
     # extra ExpertConfig kwargs (detector differentials tune the health
     # cadence/thresholds per fault kind)
     expert_overrides: dict = field(default_factory=dict)
@@ -141,6 +147,12 @@ class _Cluster:
             kernel_log_cap=256, kernel_capacity=4,
             kernel_pipeline_depth=self.pipeline_depth,
             logdb=LogDBConfig(shards=1, recovery_mode="quarantine"))
+        if self.mesh_resident:
+            # one shared ('g','r') = (1, n) mesh across the hosts; the
+            # spec name keys the engine registry so every host attaches
+            # to the SAME engine (one device per replica slot)
+            kw["mesh"] = MeshSpec(name=f"cs{self.seed}-mesh", g_size=1,
+                                  replicas=self.n, n_local=1)
         kw.update(self.expert_overrides)
         return NodeHostConfig(
             raft_address=self.addrs[rid], rtt_millisecond=5,
@@ -159,7 +171,8 @@ class _Cluster:
             cfg = Config(shard_id=sid, replica_id=rid, election_rtt=10,
                          heartbeat_rtt=1, snapshot_entries=0,
                          compaction_overhead=5,
-                         device_resident=self.device_resident)
+                         device_resident=self.device_resident,
+                         mesh_resident=self.mesh_resident)
             self.cfgs[(rid, sid)] = cfg
             nh.start_replica(dict(self.addrs), False, self.sm_cls, cfg)
         self.hosts[rid] = nh
@@ -242,11 +255,15 @@ class _Cluster:
 
     def _ev_drop(self, rid: int, p: dict) -> dict:
         self.hosts[rid].transport.drop_predicate = _counter_pred(p["every"])
+        # device-resident mesh links never see transport predicates —
+        # force this host's links onto the hub so the fault applies
+        self.hosts[rid]._set_mesh_hub_served(True)
         return {"applied": self.live(rid)}
 
     def _ev_delay(self, rid: int, p: dict) -> dict:
         secs = p["seconds"]
         self.hosts[rid].transport.delay_func = lambda m: secs
+        self.hosts[rid]._set_mesh_hub_served(True)
         return {"applied": self.live(rid)}
 
     def _ev_duplicate(self, rid: int, p: dict) -> dict:
@@ -264,6 +281,8 @@ class _Cluster:
         t.delay_func = None
         t.duplicate_predicate = None
         t.reorder_rng = None
+        # restore this host's mesh links resident (drop/delay cut them)
+        self.hosts[rid]._set_mesh_hub_served(False)
         return {"applied": True}
 
     def _ev_partition(self, rid: int, p: dict) -> dict:
@@ -400,18 +419,23 @@ def run_schedule(seed: int, plan: FaultPlan | None = None,
                  proposals_per_step: int = 4,
                  converge_timeout: float = 30.0,
                  device_resident: bool = False,
-                 pipeline_depth: int = 0) -> ScheduleResult:
+                 pipeline_depth: int = 0,
+                 mesh_resident: bool = False) -> ScheduleResult:
     """Execute one composed fault schedule; returns the recorded trace
     (canonical JSON) and the oracle report.  Pass ``plan`` to replay a
     recorded trace (``FaultPlan.from_json``) instead of generating.
     ``device_resident=True`` runs the shards on the batched kernel
     engine, ``pipeline_depth=1`` additionally through the overlapped
-    donating step loop — so faults land while a step is in flight."""
+    donating step loop — so faults land while a step is in flight.
+    ``mesh_resident=True`` runs them as rows of one shared mesh engine:
+    transport faults then exercise the per-link cut masks (hub
+    fallback) instead of the chan transport alone."""
     if plan is None:
         plan = FaultPlan.generate(seed, n_replicas=n_replicas, steps=steps)
     cluster = _Cluster(seed=seed, n=plan.n_replicas,
                        device_resident=device_resident,
-                       pipeline_depth=pipeline_depth)
+                       pipeline_depth=pipeline_depth,
+                       mesh_resident=mesh_resident)
     executed: list = []
     acked: list = []
     applied_samples: dict = {}

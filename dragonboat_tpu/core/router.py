@@ -32,6 +32,51 @@ from dragonboat_tpu.core.kernel import onehot_select, step
 MT = pb.MessageType
 I32 = jnp.int32
 
+# ---------------------------------------------------------------------------
+# slot-layout helpers (shared, PUBLIC): the host-side fallback stager
+# (engine/kernel_engine._InboxBuilder in mesh mode) must place a
+# hub-delivered message in EXACTLY the slot route() would have used, or
+# the device-resident and hub-fallback delivery paths stop being bitwise
+# interchangeable (tests/test_engine_differential.py third arm).  Every
+# piece of layout arithmetic lives here so the two sides cannot drift.
+# ---------------------------------------------------------------------------
+
+#: slots per remote peer in the fixed inbox layout (module docstring)
+SLOTS_PER_PEER = 5
+#: class offsets within one peer's slot block
+SLOT_RESP0, SLOT_RESP1, SLOT_REP, SLOT_HB, SLOT_VOTE = range(SLOTS_PER_PEER)
+
+#: route()-producible message types -> slot offset within the peer block.
+#: Responses get two lanes (SLOT_RESP0 then SLOT_RESP1); the vote slot is
+#: shared by mutually-exclusive senders (a replica never sends a vote
+#: request AND TimeoutNow in one step).
+SLOT_OFFSETS_OF_TYPE = {
+    int(MT.REPLICATE): (SLOT_REP,),
+    int(MT.HEARTBEAT): (SLOT_HB,),
+    int(MT.REQUEST_VOTE): (SLOT_VOTE,),
+    int(MT.REQUEST_PREVOTE): (SLOT_VOTE,),
+    int(MT.TIMEOUT_NOW): (SLOT_VOTE,),
+}
+# everything else (responses and host-originated kernel messages such as
+# UNREACHABLE / SNAPSHOT_STATUS) rides the response lanes
+_RESP_OFFSETS = (SLOT_RESP0, SLOT_RESP1)
+
+
+def peer_ordinal(target_rid: int, source_rid: int, replicas: int) -> int:
+    """Remote-peer ordinal ``q`` of ``source_rid`` as seen by
+    ``target_rid``: the inverse of route()'s source enumeration
+    ``s = (t + 1 + q) % R`` (both rids 1-based, q in 0..R-2)."""
+    return (source_rid - target_rid - 1) % replicas
+
+
+def slot_candidates(target_rid: int, source_rid: int, replicas: int,
+                    mtype: int) -> tuple[int, ...]:
+    """Inbox slot indexes (in preference order) where route() would place
+    a ``mtype`` message from ``source_rid`` addressed to ``target_rid``."""
+    base = peer_ordinal(target_rid, source_rid, replicas) * SLOTS_PER_PEER
+    offs = SLOT_OFFSETS_OF_TYPE.get(int(mtype), _RESP_OFFSETS)
+    return tuple(base + o for o in offs)
+
 
 def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
     """Turn one step's StepOutput into the next step's Inbox, fully on device.
@@ -40,7 +85,8 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
     """
     R = replicas
     K, E = kp.inbox_cap, kp.msg_entries
-    assert K >= 5 * (R - 1), "inbox_cap too small for the fixed slot layout"
+    assert K >= SLOTS_PER_PEER * (R - 1), \
+        "inbox_cap too small for the fixed slot layout"
     G = out.term.shape[0]
     N = G // R
 
@@ -162,7 +208,7 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
                 return jnp.take_along_axis(x4, idx, axis=2)[:, :, 0]
             return onehot_select(oh_src[None, :, :, None], x4, 2)
 
-        base = q * 5
+        base = q * SLOTS_PER_PEER
         # responses
         for lane_no, (lane, vmask) in enumerate(
             ((first, resp_valid1), (second, resp_valid2))
@@ -185,7 +231,7 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
                 jnp.where(v, take(pick(r_hint_high, lane)), 0))
         # replicate
         v = take(rep_valid)
-        k_slot = base + 2
+        k_slot = base + SLOT_REP
         fields["mtype"] = fields["mtype"].at[:, :, k_slot].set(
             jnp.where(v, MT.REPLICATE, 0))
         fields["from_"] = fields["from_"].at[:, :, k_slot].set(
@@ -209,7 +255,7 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
                 jnp.where(v[..., None], take4(rep_ent_v), 0))
         # heartbeat
         v = take(hb_valid)
-        k_slot = base + 3
+        k_slot = base + SLOT_HB
         fields["mtype"] = fields["mtype"].at[:, :, k_slot].set(
             jnp.where(v, MT.HEARTBEAT, 0))
         fields["from_"] = fields["from_"].at[:, :, k_slot].set(
@@ -225,7 +271,7 @@ def route(kp: KP.KernelParams, replicas: int, out: StepOutput) -> Inbox:
         # vote request or TimeoutNow
         vk = take(vt_kind)
         tn = take(tn_valid)
-        k_slot = base + 4
+        k_slot = base + SLOT_VOTE
         mt = jnp.where(
             tn, MT.TIMEOUT_NOW,
             jnp.where(vk == 1, MT.REQUEST_VOTE,

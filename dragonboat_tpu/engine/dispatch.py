@@ -31,12 +31,14 @@ engine-unity pass enforces (pure literals, parsed with
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dragonboat_tpu import capacity as _capacity
 from dragonboat_tpu.core import params as KP
 from dragonboat_tpu.core.kernel import (
+    FLAG_CLASSES,
     step as kernel_step,
     step_donated as kernel_step_donated,
 )
@@ -138,11 +140,6 @@ DISPATCH_ENTRIES = {
 #: here is a REVIEWED claim that the sync is off the per-step critical
 #: path or deliberately masked/lazy.
 SYNC_POINTS = {
-    "MeshDispatch.pending": {
-        "tag": "mesh_pending",
-        "why": "lazy int() of the carried pending-count scalar, one "
-               "step after dispatch so staging overlaps the device step",
-    },
     "_LazyOut.__getitem__": {
         "tag": "lazy_out",
         "why": "memoized per-field StepOutput fetch — the masked-fetch "
@@ -223,13 +220,13 @@ TRANSFER_LEDGER = {
             {"value": "StepInput", "param": "inp",
              "site": "_InputBuilder.to_device", "tag": "input_up",
              "per_step": True},
-            {"value": "[G] bool", "param": "cut",
+            {"value": "[G, P] bool", "param": "cut",
              "site": "MeshDispatch.dispatch", "tag": "cut_up",
              "per_step": False, "cached": True},
+            {"value": "Inbox", "site": "_InboxBuilder.to_device",
+             "tag": "inbox_up", "per_step": False},
         ),
         "down": (
-            {"value": "[] i32", "site": "MeshDispatch.pending",
-             "tag": "mesh_pending", "per_step": True},
             {"value": "[G, 8] bool",
              "site": "KernelEngine._process_outputs",
              "tag": "output_flags", "per_step": True},
@@ -246,13 +243,13 @@ TRANSFER_LEDGER = {
             {"value": "StepInput", "param": "inp",
              "site": "_InputBuilder.to_device", "tag": "input_up",
              "per_step": True},
-            {"value": "[G] bool", "param": "cut",
+            {"value": "[G, P] bool", "param": "cut",
              "site": "MeshDispatch.dispatch", "tag": "cut_up",
              "per_step": False, "cached": True},
+            {"value": "Inbox", "site": "_InboxBuilder.to_device",
+             "tag": "inbox_up", "per_step": False},
         ),
         "down": (
-            {"value": "[] i32", "site": "MeshDispatch.pending",
-             "tag": "mesh_pending", "per_step": True},
             {"value": "[G, 8] bool",
              "site": "KernelEngine._process_outputs",
              "tag": "output_flags", "per_step": True},
@@ -355,6 +352,10 @@ class SerialDispatch:
         """No device-resident inbox: nothing carries between steps."""
         return False
 
+    def note_output_flags(self, flags) -> None:
+        """No carried inbox, so retired activity flags carry no drain
+        information here; MeshDispatch derives pending() from them."""
+
     def inbox_from(self, inbox_buf):
         """[G, K] sender ids for the inbox-occupancy histogram — the
         host-staged builder is the inbox here."""
@@ -371,10 +372,19 @@ class SerialDispatch:
         return ()
 
 
+#: FLAG_CLASSES columns that carry inter-replica messages — the classes
+#: whose routed traffic keeps the mesh draining (need_snapshot/wit_snap/
+#: rtr are host-escalation signals, not inbox content)
+_MSG_FLAG_COLS = [FLAG_CLASSES.index(c)
+                  for c in ("resp", "rep", "hb", "vote", "timeout_now")]
+
+
 class MeshDispatch:
     """shard_map backend over a ``Mesh(('g','r'))``: messages ride the
     mesh inside the step (parallel/ici.py), the inbox is device-resident
-    between steps, and a partition mask cuts chaos-injected rows."""
+    between steps, and a per-link cut mask decides which links the mesh
+    serves — traffic for cut links (and off-mesh peers) rides the host
+    hub and is merged back into the carried inbox at its route() slot."""
 
     def __init__(self, cluster: IciCluster) -> None:
         self.cluster = cluster
@@ -382,14 +392,15 @@ class MeshDispatch:
         # device-resident inbox carried between steps (messages ride
         # the mesh, not the host queues)
         self.box = cluster.shard(empty_inbox(cluster.kp, total))
-        self._pending_msgs = 0
-        # device scalar from the LAST step, synced to the host lazily
-        # in pending(): an eager int() would block the step loop on the
-        # whole device step right at dispatch, defeating the pipelined
-        # overlap
-        self._pending_dev = None
-        # partition mask; device copy cached until the mask changes
-        self.cut = np.zeros((total,), bool)
+        # drain-pending, derived host-side from the [G, C] activity
+        # flags the step loop already fetches every step — the round-16
+        # per-step pending-scalar download is gone
+        self._pending_msgs = False
+        # per-link cut mask [rows, num_peers]: cut[row, p] severs the
+        # mesh link between the row and its group peer rid p+1 (mesh
+        # addressing pins peer slot p to rid p+1).  Device copy cached
+        # until the mask changes.
+        self.cut = np.zeros((total, cluster.kp.num_peers), bool)
         self._cut_dev = None
         self.entries = {
             "serve_step": _capacity.TRACKER.wrap(
@@ -400,34 +411,48 @@ class MeshDispatch:
 
     def dispatch(self, state, inbox, inp, donate: bool):
         """Advance the mesh: host-staged inputs, device-routed messages.
-        The host inbox builder is ignored — kernel-family traffic for
-        mesh shards never crosses the host (anything staged there is a
-        stray transport delivery and is dropped by design).
-        ``donate=True`` hands state, the carried inbox and the staged
-        input to XLA (kstate.DONATION ``serve_step_donated``); the
-        cached cut mask is never donated."""
+        Kernel-family traffic between mesh rows rides the exchange
+        inside the step; the host inbox builder is consulted ONLY for
+        hub-fallback deliveries (cut links, off-mesh senders), staged
+        slot-exact by _InboxBuilder and merged into the carried inbox
+        before the entry runs.  ``donate=True`` hands state, the carried
+        inbox and the staged input to XLA (kstate.DONATION
+        ``serve_step_donated``); the cached cut mask is never donated."""
         cl = self.cluster
+        if inbox is not None and inbox.mtype.any():
+            staged_box = cl.shard(inbox.to_device())
+            if self.box.ent_val is not None and staged_box.ent_val is None:
+                staged_box = staged_box._replace(
+                    ent_val=jnp.zeros_like(self.box.ent_val))
+            live = staged_box.mtype != 0
+            self.box = jax.tree.map(
+                lambda s, b: jnp.where(
+                    live.reshape(live.shape + (1,) * (s.ndim - 2)), s, b),
+                staged_box, self.box)
         staged = cl.shard(inp.to_device())
         if self._cut_dev is None:
             with _capacity.METER.sanctioned("cut_up"):
                 self._cut_dev = cl.shard(jnp.asarray(self.cut))
         entry = self.entries["serve_step_donated" if donate
                              else "serve_step"]
-        state, box, out, pending = entry(
+        state, box, out = entry(
             cl.kp, cl, state, self.box, staged, self._cut_dev)
         self.box = box
-        # keep the pending count device-side; the next pending() call
-        # syncs it (after staging has already overlapped the step)
-        self._pending_dev = pending
         return state, out
 
     def pending(self) -> bool:
-        p = self._pending_dev
-        if p is not None:
-            self._pending_dev = None
-            with _capacity.METER.sanctioned("mesh_pending"):
-                self._pending_msgs = int(p)
-        return self._pending_msgs > 0
+        return self._pending_msgs
+
+    def note_output_flags(self, flags) -> None:
+        """Derive drain-pending from the retired step's [G, C] activity
+        flags (already host-side — no extra crossing): any messaging
+        class set means the exchange routed traffic into the carried
+        inbox (or the hub is about to carry it), so the next step has
+        work.  Conservative under cut links — flags are computed from
+        the unmasked output, so a fully-cut row costs at most one idle
+        step — and never an undercount: the carried inbox only ever
+        holds routed copies of flagged output lanes."""
+        self._pending_msgs = bool(flags[:, _MSG_FLAG_COLS].any())
 
     def inbox_from(self, inbox_buf):
         # the mesh inbox is device-resident between steps; no host copy
@@ -439,9 +464,19 @@ class MeshDispatch:
         return self.cluster.shard(tree)
 
     def set_cut(self, lane: int, cut: bool) -> None:
-        """Flip one row's partition mask and invalidate the cached
-        device copy (next dispatch re-stages it)."""
-        self.cut[lane] = cut
+        """Flip one row's WHOLE partition mask (every link of the row)
+        and invalidate the cached device copy (next dispatch re-stages
+        it).  This is the chaos PartitionNode surface: the row neither
+        sends nor receives on the mesh."""
+        self.cut[lane, :] = cut
+        self._cut_dev = None
+
+    def set_link_cut(self, lane: int, peer_rid: int, cut: bool) -> None:
+        """Flip ONE directed half-link: row ``lane`` stops exchanging
+        with group peer rid ``peer_rid`` over the mesh.  Callers must
+        cut links symmetrically (both endpoints) — hub fallback relies
+        on the peer's sender-side mask to emit its half over the host."""
+        self.cut[lane, peer_rid - 1] = cut
         self._cut_dev = None
 
     def resident_trees(self) -> tuple:

@@ -62,6 +62,7 @@ from dragonboat_tpu.core.kernel import (
     step as kernel_step,
     step_donated as kernel_step_donated,
 )
+from dragonboat_tpu.core import router as _router
 from dragonboat_tpu.core.kstate import (
     Inbox,
     ShardState,
@@ -386,8 +387,12 @@ class KernelEngine:
         # its numpy staging on CPU backends, and whose buffers are
         # donated) is still in flight; a pair is only rewritten after
         # the step that used it has retired
+        # mesh subclasses set _slot_exact_replicas BEFORE super().__init__
+        # so hub-fallback staging lands at route()'s exact slot layout
+        mesh_r = getattr(self, "_slot_exact_replicas", None)
         self._bufs = tuple(
-            (_InboxBuilder(capacity, kp.inbox_cap, kp.msg_entries),
+            (_InboxBuilder(capacity, kp.inbox_cap, kp.msg_entries,
+                           mesh_replicas=mesh_r),
              _InputBuilder(capacity, kp.proposal_cap))
             for _ in range(2))
         self._buf_idx = 0
@@ -1358,6 +1363,9 @@ class KernelEngine:
             lifecycle.TRACER.stamp(k, lifecycle.STAGE_RETIRE)
         with _capacity.METER.sanctioned("output_flags"):
             flags = np.asarray(output_row_flags(out))
+        # the dispatch backend derives drain-pending from the same flags
+        # (MeshDispatch dropped its per-step pending-scalar download)
+        self._dispatch.note_output_flags(flags)
         o = _LazyOut(out)
         pid = self._pid_np
         kind = self._kind_np
@@ -1781,7 +1789,8 @@ _FAMILY_OF_TYPE = {
 
 
 class _InboxBuilder:
-    def __init__(self, G: int, K: int, E: int) -> None:
+    def __init__(self, G: int, K: int, E: int,
+                 mesh_replicas: int | None = None) -> None:
         self.K, self.E = K, E
         # typed slot layout (params.slot_families): a message may only be
         # staged into a slot whose family accepts its type ('any' accepts
@@ -1791,6 +1800,11 @@ class _InboxBuilder:
         for fam in ("rep", "hb", "vote", "resp"):
             self._slots_for[fam] = tuple(
                 k for k, f in enumerate(fams) if f in (fam, "any"))
+        # slot-exact mode (mesh engines): hub-fallback deliveries must
+        # land at the SAME route() slot the mesh exchange would have
+        # used, so the merged carried inbox is bit-identical to a fully
+        # resident exchange (core/router.py slot_candidates)
+        self._mesh_R = mesh_replicas
         self.mtype = np.zeros((G, K), np.int32)
         self.from_ = np.zeros((G, K), np.int32)
         self.term = np.zeros((G, K), np.int32)
@@ -1811,9 +1825,19 @@ class _InboxBuilder:
             a.fill(0)
 
     def add(self, g: int, m: pb.Message, n: KernelNode) -> bool:
-        fam = _FAMILY_OF_TYPE.get(int(m.type), "resp")
+        if self._mesh_R is not None:
+            R = self._mesh_R
+            if m.from_ == n.replica_id or not (1 <= m.from_ <= R):
+                # unroutable on the mesh layout: a stray delivery, not a
+                # full inbox — swallow it (True = no requeue) like the
+                # pre-round-17 hub drop did
+                return True
+            cands = _router.slot_candidates(
+                n.replica_id, m.from_, R, int(m.type))
+        else:
+            cands = self._slots_for[_FAMILY_OF_TYPE.get(int(m.type), "resp")]
         k = -1
-        for cand in self._slots_for[fam]:
+        for cand in cands:
             if self.mtype[g, cand] == 0:
                 k = cand
                 break

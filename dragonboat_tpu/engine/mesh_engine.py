@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from dragonboat_tpu import capacity as _capacity
+from dragonboat_tpu import fabric as _fabric
 from dragonboat_tpu import raftpb as pb
 from dragonboat_tpu.config import MeshSpec
 from dragonboat_tpu.core import params as KP
@@ -47,6 +48,7 @@ from dragonboat_tpu.engine.kernel_engine import (
     KernelEngine,
     KernelNode,
     _F_WITSNAP,
+    _KERNEL_MTYPES,
     _LaneInit,
 )
 from dragonboat_tpu.logger import get_logger
@@ -86,6 +88,9 @@ class MeshEngine(KernelEngine):
             kp=kp, mesh=mesh, replicas=spec.replicas,
             n_local=spec.n_local, num_groups=spec.g_size * spec.n_local)
         total = self.cluster.total_rows
+        # read by KernelEngine.__init__ below: hub-fallback deliveries
+        # stage slot-exact against route()'s layout (_InboxBuilder)
+        self._slot_exact_replicas = spec.replicas
         super().__init__(kp, total, send_message=None, events=events,
                          fleet_stats_every=fleet_stats_every,
                          pipeline_depth=pipeline_depth,
@@ -164,6 +169,7 @@ class MeshEngine(KernelEngine):
             self.nodes[row] = node
             self.by_shard[(node.shard_id, node.replica_id)] = node
             self._inject(row, node, init)
+            self._note_link_classes(node)
 
     def remove_replica(self, node: KernelNode) -> KernelNode | None:
         """Detach one replica (stop_replica / NodeHost.close); the group
@@ -172,6 +178,9 @@ class MeshEngine(KernelEngine):
             if self.by_shard.pop((node.shard_id, node.replica_id),
                                  None) is None:
                 return None
+            addr = self._link_class_book(node).get(node.replica_id)
+            if addr:
+                _fabric.METER.drop_link_classes(addr)
             members = self._members.get(node.shard_id, {})
             members.pop(node.replica_id, None)
             self.nodes.pop(node.lane, None)
@@ -201,13 +210,87 @@ class MeshEngine(KernelEngine):
         return min((m.sm.get_last_applied() for m in members),
                    default=n.sm.get_last_applied())
 
+    # -- fabric link classes ----------------------------------------------
+
+    @staticmethod
+    def _link_class_book(node: KernelNode) -> dict:
+        """rid -> raft address from the node's own durable membership —
+        the same book update_lane_membership reads."""
+        m = node.sm.get_membership()
+        return {**m.addresses, **m.non_votings, **m.witnesses}
+
+    def _note_link_classes(self, node: KernelNode) -> None:
+        """Refresh the fabric meter's carrier class for every co-
+        resident link of ``node`` from the live cut mask (resident =
+        mesh-carried, hub = cut/partitioned), both directions.  Links
+        to absent or off-mesh peers stay unregistered: they are hub
+        links by construction and the meter already counts their
+        traffic.  Caller holds self.mu; the meter takes only its own
+        lock."""
+        book = self._link_class_book(node)
+        me = book.get(node.replica_id)
+        if not me:
+            return
+        for rid, peer in self._members.get(node.shard_id, {}).items():
+            if rid == node.replica_id:
+                continue
+            them = self._link_class_book(peer).get(rid) or book.get(rid)
+            if not them:
+                continue
+            cls = (_fabric.LINK_CLASS_HUB
+                   if bool(self._dispatch.cut[node.lane, rid - 1])
+                   else _fabric.LINK_CLASS_RESIDENT)
+            _fabric.METER.set_link_class(me, them, cls)
+            _fabric.METER.set_link_class(them, me, cls)
+
     # -- chaos surface -----------------------------------------------------
 
     def set_partitioned(self, node: KernelNode, cut: bool) -> None:
-        """Device-side partition mask for one replica row."""
+        """Device-side partition mask for one replica row (every link)."""
         with self.mu:
             if self._is_registered(node):
                 self._dispatch.set_cut(node.lane, cut)
+                self._note_link_classes(node)
+
+    def set_link_hub_served(self, node: KernelNode, peer_rid: int,
+                            cut: bool) -> None:
+        """Cut (or heal) ONE mesh link, symmetrically: traffic between
+        ``node``'s row and its group peer ``peer_rid`` leaves the mesh
+        and rides the host hub — where transport faults (drop/delay)
+        apply to it like any other hub traffic.  Both endpoints are
+        masked together: hub fallback relies on the peer's sender-side
+        mask to emit its half over the host (MeshDispatch.set_link_cut)."""
+        if not (1 <= peer_rid <= self.spec.replicas):
+            return
+        with self.mu:
+            if not self._is_registered(node):
+                return
+            self._dispatch.set_link_cut(node.lane, peer_rid, cut)
+            peer = self._members.get(node.shard_id, {}).get(peer_rid)
+            if peer is not None:
+                self._dispatch.set_link_cut(
+                    peer.lane, node.replica_id, cut)
+            self._note_link_classes(node)
+
+    def hub_accepts(self, node: KernelNode, m: pb.Message) -> bool:
+        """NodeHost inbound gate for a mesh-resident replica: kernel-
+        family traffic lands only when the hub is that link's carrier
+        (link_hub_served); host-mediated traffic (snapshot streams and
+        the like) always lands."""
+        if m.type not in _KERNEL_MTYPES:
+            return True
+        return self.link_hub_served(node, int(m.from_))
+
+    def link_hub_served(self, node: KernelNode, from_rid: int) -> bool:
+        """True when the hub must deliver ``from_rid`` -> ``node``: the
+        link is cut, or the sender is off-mesh/absent.  Resident links
+        return False — the mesh already carried the message, so the hub
+        copy (if any) is a stray and the NodeHost drops it."""
+        if not (1 <= from_rid <= self.spec.replicas):
+            return True
+        if self._members.get(node.shard_id, {}).get(from_rid) is None:
+            return True
+        return bool(self._dispatch.cut[node.lane, from_rid - 1])
 
     # -- the step ----------------------------------------------------------
 
@@ -223,30 +306,57 @@ class MeshEngine(KernelEngine):
 
     def _emit_messages(self, g, n, o, fl, pid, kind,
                        replicates, others) -> None:
-        # intra-group messages ride the mesh inside the step; there is
-        # nothing for the host to send (READ_INDEX forwarding and
-        # snapshot streams go through the per-node host path).  A witness
-        # peer needing a snapshot CANNOT be served over the mesh (witness
-        # replicas are host-resident, their mesh row is absent) — the
-        # group escalates to the host engines, which recover it
+        # intra-group messages ride the mesh inside the step; the host
+        # sends ONLY the hub-fallback traffic of cut links (READ_INDEX
+        # forwarding and snapshot streams go through the per-node host
+        # path).  A witness peer needing a snapshot CANNOT be served
+        # over the mesh (witness replicas are host-resident, their mesh
+        # row is absent) — the group escalates to the host engines
         if fl[_F_WITSNAP] and o["s_wit_snap"][g].any():
             self._wit_snap_fallback.add(n.shard_id)
+        cut = self._dispatch.cut[g]
+        if not cut.any():
+            return
+        # hub fallback: rebuild EXACTLY the messages the mesh exchange
+        # masked out (sender-side per-link mask, parallel/ici.py
+        # _mask_outgoing reads the same unmasked output fields) and keep
+        # only the ones addressed over cut links.  The wit_snap branch is
+        # suppressed — it is host-escalation, handled above, not link
+        # traffic.
+        fl = fl.copy()
+        fl[_F_WITSNAP] = False
+        reps: list = []
+        oths: list = []
+        super()._emit_messages(g, n, o, fl, pid, kind, reps, oths)
+        R = self.spec.replicas
+        for built, dst in ((reps, replicates), (oths, others)):
+            for item in built:
+                to = item[1].to
+                if 1 <= to <= R and cut[to - 1]:
+                    dst.append(item)
 
     def _prop_target(self, n: KernelNode):
         """Forward proposals to the group's leader row (any NodeHost is a
         valid entry point, like the reference's MsgProp forwarding). Falls
         back to the proposer's own row when no leader is known — the
         kernel then drops and the client retries."""
-        if self._dispatch.cut[n.lane]:
-            # a partitioned host's proposals must not tunnel through
-            # shared memory to the leader row — stage on the cut row,
-            # where the kernel drops them (the client sees DROPPED, as it
-            # would against the reference's silenced transport)
+        lane_cut = self._dispatch.cut[n.lane]
+        if lane_cut.all():
+            # a fully partitioned host's proposals must not tunnel
+            # through shared memory to the leader row — stage on the cut
+            # row, where the kernel drops them (the client sees DROPPED,
+            # as it would against the reference's silenced transport)
             return n.lane, n
         lid = n._leader_cache
         if lid and lid != n.replica_id:
             leader = self._members.get(n.shard_id, {}).get(lid)
-            if leader is not None and not self._dispatch.cut[leader.lane]:
+            # per-link discipline: forwarding IS a proposer->leader send,
+            # so a cut link (or a fully cut leader row) blocks it — the
+            # proposal stays on the proposer's row, the kernel drops it
+            # there and the client retries
+            if (leader is not None
+                    and not lane_cut[lid - 1]
+                    and not self._dispatch.cut[leader.lane].all()):
                 return leader.lane, leader
         return n.lane, n
 

@@ -66,6 +66,13 @@ CLASS_OTHER = "other"
 MESSAGE_CLASSES = (CLASS_REQUEST_VOTE, CLASS_APPEND, CLASS_HEARTBEAT,
                    CLASS_READ_INDEX, CLASS_SNAPSHOT, CLASS_OTHER)
 
+#: carrier classes for a co-located link (round 17): ``resident`` =
+#: consensus traffic rides the in-step mesh collective, ``hub`` = cut /
+#: partitioned, host-hub delivered (the fallback matrix in README)
+LINK_CLASS_RESIDENT = "resident"
+LINK_CLASS_HUB = "hub"
+LINK_CLASSES = (LINK_CLASS_RESIDENT, LINK_CLASS_HUB)
+
 _CLASS_OF = {
     pb.MessageType.REQUEST_VOTE: CLASS_REQUEST_VOTE,
     pb.MessageType.REQUEST_VOTE_RESP: CLASS_REQUEST_VOTE,
@@ -140,6 +147,9 @@ class FabricMeter:
         self._max_census = max(1, int(max_census))
         self._max_remote = max(1, int(max_remote))
         self._links: dict[tuple[str, str], _Link] = {}      # guarded-by: mu
+        # carrier class per directed link ("resident" | "hub"), kept by
+        # the mesh engine's cut-mask transitions (round 17)
+        self._link_classes: dict[tuple[str, str], str] = {}  # guarded-by: mu
         # hop census per traced proposal key: origin, crossings so far,
         # distinct hosts (insertion-ordered dict used as a set — the
         # determinism lint bans bare set iteration)
@@ -208,10 +218,32 @@ class FabricMeter:
         with self.mu:
             self._hubs[addr] = weakref.ref(hub)
 
+    def set_link_class(self, src: str, dst: str, cls: str) -> None:
+        """Classify one directed link's carrier for the doctor view:
+        ``resident`` (the mesh collective carries it; the hub never
+        sees its consensus traffic) or ``hub`` (cut / partitioned /
+        off-mesh — host-hub delivered).  The mesh engine refreshes
+        these on admission and on every per-link cut flip; unregistered
+        links are hub links by construction."""
+        if cls not in LINK_CLASSES:
+            raise ValueError(f"unknown link class {cls!r} "
+                             f"(want one of {sorted(LINK_CLASSES)})")
+        with self.mu:
+            self._link_classes[(str(src), str(dst))] = cls
+
+    def drop_link_classes(self, addr: str) -> None:
+        """Forget every link class touching ``addr`` (replica detached:
+        its resident links are gone, not healed)."""
+        with self.mu:
+            for key in [k for k in self._link_classes
+                        if addr in k]:
+                del self._link_classes[key]
+
     def reset(self) -> None:
         """Drop links, census, spans and hub attachments (tests)."""
         with self.mu:
             self._links.clear()
+            self._link_classes.clear()
             self._census.clear()
             self._hops_done.clear()
             self._census_finished = 0
@@ -522,6 +554,9 @@ class FabricMeter:
             }
             remote = {"active": len(self._remote),
                       "retired": len(self._remote_ring)}
+            link_classes = {f"{src}->{dst}": cls
+                            for (src, dst), cls
+                            in sorted(self._link_classes.items())}
             hubs = list(self._hubs.items())
             enabled = self._enabled
         hub_view = {}
@@ -542,7 +577,8 @@ class FabricMeter:
                              for peer, b in sorted(breakers)},
             }
         return {"enabled": enabled, "links": links, "census": census,
-                "remote_spans": remote, "hubs": hub_view}
+                "remote_spans": remote, "hubs": hub_view,
+                "link_classes": link_classes}
 
 
 def validate_fabric(obj, where: str = "fabric") -> int:
@@ -554,9 +590,20 @@ def validate_fabric(obj, where: str = "fabric") -> int:
     if not isinstance(obj, dict):
         raise ValueError(f"{where}: must be an object, "
                          f"got {type(obj).__name__}")
-    for req in ("enabled", "links", "census", "remote_spans", "hubs"):
+    for req in ("enabled", "links", "census", "remote_spans", "hubs",
+                "link_classes"):
         if req not in obj:
             raise ValueError(f"{where}: missing required key {req!r}")
+    lc = obj["link_classes"]
+    if not isinstance(lc, dict):
+        raise ValueError(f"{where}.link_classes: must be an object")
+    for link, cls in lc.items():
+        if not isinstance(link, str) or "->" not in link:
+            raise ValueError(f"{where}.link_classes: key {link!r} must "
+                             f"be a 'src->dst' string")
+        if cls not in LINK_CLASSES:
+            raise ValueError(f"{where}.link_classes.{link}: unknown "
+                             f"link class {cls!r}")
     if not isinstance(obj["enabled"], bool):
         raise ValueError(f"{where}.enabled: must be a bool")
     if not isinstance(obj["links"], list):

@@ -1289,6 +1289,15 @@ class NodeHost:
             with self.mu:
                 node = self.nodes.get(m.shard_id)
             if node is not None:
+                # hub delivery skips links the mesh serves: a resident
+                # link's copy is a stray (the exchange already carried
+                # it) and accepting it would double-deliver; cut links
+                # and off-mesh senders keep the hub as their carrier
+                eng = self.mesh_engine
+                if (eng is not None
+                        and getattr(node, "engine", None) is eng
+                        and not eng.hub_accepts(node, m)):
+                    continue
                 node.handle_message(m)
         self._work.set()
 
@@ -1839,6 +1848,25 @@ class NodeHost:
         for n in nodes:
             if getattr(n, "engine", None) is self.mesh_engine:
                 self.mesh_engine.set_partitioned(n, cut)
+
+    def _set_mesh_hub_served(self, served: bool) -> None:
+        """Force every mesh link of THIS host's replicas onto the hub
+        (symmetrically, both endpoints) so transport faults — drop,
+        delay — apply to its consensus traffic like any other hub
+        traffic.  Healing restores the links resident; a concurrent
+        fault on a peer's host sharing a link is healed with it (chaos
+        plans schedule soft transport faults one host at a time)."""
+        eng = self.mesh_engine
+        if eng is None:
+            return
+        with self.mu:
+            nodes = list(self.nodes.values())
+        for n in nodes:
+            if getattr(n, "engine", None) is not eng:
+                continue
+            for rid in range(1, eng.spec.replicas + 1):
+                if rid != n.replica_id:
+                    eng.set_link_hub_served(n, rid, served)
 
     def get_session_hash(self, shard_id: int) -> int:
         """Convergence oracle over the session book (monkey.go:117)."""

@@ -175,18 +175,54 @@ def ici_cluster_step(cluster: IciCluster, state: ShardState, box: Inbox,
 
 
 def _mask_outgoing(out: StepOutput, cut: jnp.ndarray) -> StepOutput:
-    """Zero the message-valid lanes of cut rows (device-side partition:
-    the chaos surface monkey.go:170 PartitionNode expressed as a mask —
-    a partitioned replica neither sends nor receives, but still ticks,
-    persists and applies locally)."""
+    """Zero the out-lanes addressed over cut LINKS.
 
-    def z(a):
-        c = cut.reshape((-1,) + (1,) * (a.ndim - 1))
+    ``cut`` is the per-link mask ``[G, num_peers] bool``: ``cut[g, p]``
+    severs the mesh link between row ``g`` and its group peer rid
+    ``p + 1`` (mesh addressing pins peer slot ``p`` to rid ``p + 1``, so
+    the column index doubles as the slot index).  A whole-True row is
+    the old per-lane partition (monkey.go:170 PartitionNode): the row
+    sends nothing on the mesh, but still ticks, persists and applies.
+    A single column is the round-17 hub-fallback surface: traffic for
+    that link leaves the mesh and rides the host hub instead
+    (MeshEngine._emit_messages)."""
+    P = cut.shape[1]
+
+    def zpeer(a):  # [G, P(, E)] peer-slot lanes: zero slot p where cut
+        c = cut.reshape(cut.shape + (1,) * (a.ndim - 2))
         return jnp.where(c, jnp.zeros_like(a), a)
 
+    # response lanes are addressed by rid, not slot: lane k of row g is
+    # masked when the link to its destination rid is cut.  One-hot
+    # compare + any, NOT take_along_axis: a per-lane gather here would
+    # breach the mesh HLO budget (analysis/hlo_budget.json gates them)
+    rid = jnp.arange(1, P + 1, dtype=out.r_to.dtype)
+    cut_to = jnp.any(
+        (out.r_to[:, :, None] == rid) & cut[:, None, :], axis=-1)  # [G, K]
     return out._replace(
-        r_type=z(out.r_type), s_rep=z(out.s_rep), s_hb=z(out.s_hb),
-        s_vote=z(out.s_vote), s_timeout_now=z(out.s_timeout_now),
+        r_type=jnp.where(cut_to, jnp.zeros_like(out.r_type), out.r_type),
+        s_rep=zpeer(out.s_rep), s_hb=zpeer(out.s_hb),
+        s_vote=zpeer(out.s_vote), s_timeout_now=zpeer(out.s_timeout_now),
+    )
+
+
+def _mask_incoming(box: Inbox, cut: jnp.ndarray) -> Inbox:
+    """Zero inbox slots whose SOURCE arrives over a cut link.  Every
+    field is zeroed, not just the type: the kernel's inbox contract is
+    route()'s (invalid slots are all-zero), and a slot with mtype=0 but
+    a live term would still feed term adoption (caught by
+    tests/test_mesh_differential.py)."""
+    P = cut.shape[1]
+    # one-hot source match (gather-free, like _mask_outgoing); from_=0
+    # (empty slot) matches no rid and stays untouched
+    rid = jnp.arange(1, P + 1, dtype=box.from_.dtype)
+    cut_src = jnp.any(
+        (box.from_[:, :, None] == rid) & cut[:, None, :], axis=-1)  # [G, K]
+    return jax.tree.map(
+        lambda x: jnp.where(
+            cut_src.reshape(cut_src.shape + (1,) * (x.ndim - 2)),
+            jnp.zeros_like(x), x),
+        box,
     )
 
 
@@ -194,38 +230,35 @@ def _serve_body(kp: KP.KernelParams, replicas: int,
                 state: ShardState, box: Inbox, inp: StepInput,
                 cut: jnp.ndarray):
     """shard_map body for the SERVING path: host-staged StepInput, a
-    device-resident inbox carried between steps, and a partition mask.
+    device-resident inbox carried between steps, and a per-link cut
+    mask reserving the host hub for cut / off-mesh links.
 
-    Returns (state, next_box, out, pending): ``pending`` counts routed
-    messages still in flight so the host keeps stepping until the mesh
-    drains even when no client work arrived."""
+    Returns (state, next_box, out).  The round-16 ``pending`` scalar
+    (a per-step device->host crossing) is gone: the host derives
+    drain-pending from the [G, C] activity flags it already fetches
+    every step (MeshDispatch.note_output_flags), so the serving step
+    downloads nothing beyond the masked output path."""
     state, out = step(kp, state, box, inp)
     box = _exchange(kp, replicas, state.term.shape[0],
                     _mask_outgoing(out, cut))
-    # a cut row receives nothing either — zero EVERY field, not just the
-    # type: the kernel's inbox contract is route()'s (invalid slots are
-    # all-zero), and a slot with mtype=0 but a live term would still feed
-    # term adoption (caught by tests/test_mesh_differential.py)
-    box = jax.tree.map(
-        lambda x: jnp.where(
-            cut.reshape((-1,) + (1,) * (x.ndim - 1)), jnp.zeros_like(x), x),
-        box,
-    )
-    pending = jax.lax.psum(
-        (box.mtype != 0).sum().astype(jnp.int32), ("g", "r"))
-    return state, box, out, pending
+    # symmetric receive-side masking: with BOTH endpoints of a cut link
+    # masked, a one-sided (asymmetric) mask update can never leak a
+    # message across a link the host already re-routed over the hub
+    box = _mask_incoming(box, cut)
+    return state, box, out
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def jit_serve_step(kp, cluster: IciCluster, state, box, inp, cut):
     """Jitted serving entry (non-donated): the depth-0 mesh oracle the
-    engine dispatch layer wraps in compile telemetry."""
+    engine dispatch layer wraps in compile telemetry.  ``cut`` is the
+    per-link mask ``[G, num_peers] bool`` (see ``_mask_outgoing``)."""
     body = shard_map(
         functools.partial(_serve_body, kp, cluster.replicas),
         mesh=cluster.mesh,
         in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")),
-                  PS(("g", "r"))),
-        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")), PS()),
+                  PS(("g", "r"), None)),
+        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
     )
     return body(state, box, inp, cut)
 
@@ -236,20 +269,20 @@ def jit_serve_step_donated(kp, cluster: IciCluster, state, box, inp, cut):
     state, the carried inbox and the staged input hand their buffers to
     XLA (kstate.DONATION ``serve_step_donated``; host no-touch rule
     applies after dispatch).  ``cut`` is NOT donated — the engine caches
-    the device copy of the partition mask across steps."""
+    the device copy of the per-link mask across steps."""
     body = shard_map(
         functools.partial(_serve_body, kp, cluster.replicas),
         mesh=cluster.mesh,
         in_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")),
-                  PS(("g", "r"))),
-        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r")), PS()),
+                  PS(("g", "r"), None)),
+        out_specs=(PS(("g", "r")), PS(("g", "r")), PS(("g", "r"))),
     )
     return body(state, box, inp, cut)
 
 
 def ici_serve_step(cluster: IciCluster, state: ShardState, box: Inbox,
                    inp: StepInput, cut):
-    """One serving step: kernel + in-mesh routing + partition mask.
+    """One serving step: kernel + in-mesh routing + per-link cut mask.
 
     The mesh-engine equivalent of router.cluster_step — the transport
     seam (transport.go:86-101) is the all_gather inside the body."""

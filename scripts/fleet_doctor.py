@@ -41,9 +41,12 @@ storm counts as degraded alongside the anomaly classes.
 ``--fabric`` scrapes ``/debug/fabric`` (fabric.py per-link transport
 telemetry + hop census), validates it strictly
 (fabric.validate_fabric), and prints the hottest links — top-K by bytes
-sent and by p99 delivery latency — the hop-census summary, and each
-attached hub's queue depth and breaker states.  Any non-closed breaker
-counts as degraded (exit 1).  ``--top`` sizes K.
+sent and by p99 delivery latency — the hop-census summary, each
+attached hub's queue depth and breaker states, and the carrier class
+of every mesh-co-located link (``resident`` = served by the in-step
+collective, ``hub`` = cut/partitioned and host-delivered — round 17's
+device-resident fabric).  Any non-closed breaker counts as degraded
+(exit 1).  ``--top`` sizes K.
 
 Exit status: 0 healthy, 1 degraded (any anomaly class nonzero, memory
 pressure, a retrace storm, or — under ``--fabric`` — a tripped
@@ -213,6 +216,24 @@ def render_fabric(fab: dict, top_k: int = 5) -> str:
         f" dropped={cen['dropped']}"
         f" hops={{{' '.join(f'{h}:{n}' for h, n in sorted(cen['hop_counts'].items(), key=lambda kv: int(kv[0])))}}}",
     ]
+    # carrier classes (round 17): resident links ride the mesh
+    # collective and never show hub traffic; hub links are cut /
+    # partitioned co-located links the host delivers (fallback matrix
+    # in README).  Unclassified links (off-mesh) are hub-by-nature and
+    # appear only in the traffic tables above.
+    classes = fab.get("link_classes", {})
+    if classes:
+        by_cls: dict = {}
+        for link, cls in sorted(classes.items()):
+            by_cls.setdefault(cls, []).append(link)
+        counts = " ".join(f"{cls}={len(by_cls[cls])}"
+                          for cls in sorted(by_cls))
+        lines.append(f"  link classes: {counts}")
+        for cls in sorted(by_cls):
+            shown = by_cls[cls][:top_k]
+            more = len(by_cls[cls]) - len(shown)
+            lines.append(f"    {cls}: " + " ".join(shown)
+                         + (f" (+{more} more)" if more > 0 else ""))
 
     def link_table(title, ranked):
         if not ranked:

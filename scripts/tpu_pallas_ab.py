@@ -11,9 +11,17 @@ Measures, at the bench shape (sm_params, direct-mapped table):
   2. the full device-SM step loop (run_steps_sm) with the XLA range
      apply vs the pallas apply.
 
-Appends one JSON line (kind=pallas_ab) to PERF_TPU.jsonl.  Self-test on
-CPU with PALLAS_AB_FORCE_CPU=1 (pallas runs in interpret mode there —
-the relative number is meaningless off-TPU, the plumbing check is not).
+Round 17 adds ``kind=fabric_ab`` rungs for the device-resident fabric:
+the serving loop with hub delivery vs the in-step collective exchange
+(parallel/ici.py per-link cut mask open vs all-cut + host route), and
+the two hot gather shapes on that path — inbox lane staging and the
+quorum match select — as pallas VMEM block kernels vs their XLA
+lowerings (parallel/fabric_pallas.py).
+
+Appends JSON lines (kind=pallas_ab / pipeline_ab / fabric_ab) to
+PERF_TPU.jsonl.  Self-test on CPU with PALLAS_AB_FORCE_CPU=1 (pallas
+runs in interpret mode there — the relative number is meaningless
+off-TPU, the plumbing check is not).
 
 Usage: python scripts/tpu_pallas_ab.py [groups]
 """
@@ -25,6 +33,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# the fabric serve rung needs one host device per replica slot; must be
+# set before jax loads (harmless on real TPU: flag only affects CPU)
+if os.environ.get("PALLAS_AB_FORCE_CPU") == "1":
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 import jax.numpy as jnp
@@ -181,6 +197,140 @@ def gather_donated_ab(G: int, iters: int = 30) -> dict:
     return out
 
 
+def fabric_serve_ab(groups: int, micro: int = 40,
+                    replicas: int = 2) -> dict:
+    """Hub delivery vs device-resident exchange on the SERVING loop
+    (round 17 tentpole): both arms run jit_serve_step; the resident arm
+    serves with an all-open per-link cut mask (messages ride the
+    in-step collective), the hub arm with EVERY link cut — its
+    out-lanes are pulled to the host, staged back through
+    core/router.route (the hub fallback's addressing) and re-uploaded
+    as the next inbox.  Per-micro-step ms for each arm; the delta is
+    the host hub's tax on co-located links."""
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    from dragonboat_tpu.bench_loop import bench_params
+    from dragonboat_tpu.core import params as KP
+    from dragonboat_tpu.core.router import route
+    from dragonboat_tpu.parallel.ici import (
+        jit_serve_step,
+        make_ici_cluster,
+        self_driving_input,
+    )
+
+    devs = jax.devices()
+    if len(devs) < replicas:
+        return {"serve_error":
+                f"needs {replicas} devices, have {len(devs)}"}
+    kp = bench_params(replicas)
+    mesh = Mesh(np.array(devs[:replicas]).reshape(1, replicas),
+                ("g", "r"))
+    cluster, state, box = make_ici_cluster(kp, mesh, groups)
+    n_local = groups  # g_size=1: mesh row ir*n_local + n <-> router n*R+ir
+    perm = np.empty(groups * replicas, np.int64)
+    for n in range(groups):
+        for ir in range(replicas):
+            perm[n * replicas + ir] = ir * n_local + n
+    iperm = np.argsort(perm)
+    total = cluster.total_rows
+    cut_open = cluster.shard(np.zeros((total, kp.num_peers), bool))
+    cut_all = cluster.shard(np.ones((total, kp.num_peers), bool))
+
+    # election pump (resident path) until every group has a leader
+    for _ in range(40):
+        if int((np.asarray(state.role) == KP.LEADER).sum()) >= groups:
+            break
+        inp = self_driving_input(kp, state, propose=False)
+        state, box, _ = jit_serve_step(
+            kp, cluster, state, box, inp, cut_open)
+
+    route_jit = jax.jit(route, static_argnums=(0, 1))
+    pull = lambda t: jax.tree.map(lambda x: np.array(x), t)  # noqa: E731
+    repermute = lambda t, p: jax.tree.map(  # noqa: E731
+        lambda x: x[p], t)
+
+    arms = {"resident": (state, box), "hub": (state, box)}
+    out = {}
+    for tag in arms:
+        st, bx = arms[tag]
+        for warm in (True, False):
+            t0 = time.time()
+            for _ in range(micro):
+                inp = self_driving_input(kp, st, propose=True)
+                if tag == "resident":
+                    st, bx, _ = jit_serve_step(
+                        kp, cluster, st, bx, inp, cut_open)
+                else:
+                    st, _, outgoing = jit_serve_step(
+                        kp, cluster, st, bx, inp, cut_all)
+                    hub_box = route_jit(
+                        kp, replicas, repermute(pull(outgoing), perm))
+                    bx = cluster.shard(repermute(pull(hub_box), iperm))
+            jax.block_until_ready(st.term)
+            if warm:  # first window compiles; only the second is timed
+                continue
+            out[tag + "_step_ms"] = round(
+                (time.time() - t0) / micro * 1e3, 3)
+    if "resident_step_ms" in out and "hub_step_ms" in out:
+        out["hub_over_resident_x"] = round(
+            out["hub_step_ms"] / max(out["resident_step_ms"], 1e-9), 3)
+    return out
+
+
+def fabric_gather_ab(G: int, iters: int = 50) -> dict:
+    """The serving path's two hot gather shapes as pallas VMEM block
+    kernels vs their XLA lowerings (parallel/fabric_pallas.py): inbox
+    lane staging (batched gather) and the quorum match order statistic
+    (sort + gather).  Asserts bitwise agreement on the way."""
+    import numpy as np
+
+    from dragonboat_tpu.parallel.fabric_pallas import (
+        gather_lanes_pallas,
+        gather_lanes_xla,
+        quorum_match_pallas,
+        quorum_match_xla,
+    )
+
+    K, R = 32, 8
+    rng = np.random.default_rng(11)
+    vals = jnp.asarray(rng.integers(0, 1 << 20, (G, K)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, K, (G, K)), jnp.int32)
+    match = jnp.asarray(rng.integers(0, 1 << 16, (G, R)), jnp.int32)
+    voting = jnp.asarray(rng.random((G, R)) < 0.9)
+    q = jnp.asarray(rng.integers(1, R // 2 + 2, G), jnp.int32)
+    interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    out = {"gather_interpret": interpret}
+
+    def timed(tag, fn, *a):
+        r = fn(*a)                                  # compile
+        jax.block_until_ready(r)
+        t0 = time.time()
+        for _ in range(iters):
+            r = fn(*a)
+        jax.block_until_ready(r)
+        out[tag + "_ms"] = round((time.time() - t0) / iters * 1e3, 3)
+        return r
+
+    ref = timed("inbox_gather_xla", jax.jit(gather_lanes_xla), vals, idx)
+    try:
+        got = timed("inbox_gather_pallas",
+                    gather_lanes_pallas, vals, idx, interpret)
+        out["inbox_gather_bitwise"] = bool(jnp.array_equal(ref, got))
+    except Exception as e:
+        out["inbox_gather_pallas_error"] = str(e)[-200:]
+    ref = timed("quorum_match_xla",
+                jax.jit(quorum_match_xla), match, voting, q)
+    try:
+        got = timed("quorum_match_pallas",
+                    quorum_match_pallas, match, voting, q, interpret)
+        out["quorum_match_bitwise"] = bool(jnp.array_equal(ref, got))
+    except Exception as e:
+        out["quorum_match_pallas_error"] = str(e)[-200:]
+    return out
+
+
 def main() -> None:
     g = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
         else 1024
@@ -205,11 +355,19 @@ def main() -> None:
             "groups": g}
     pipe.update(pipeline_loop_ab(g, pipe_iters=max(5, min(25, 50_000 // g))))
     pipe.update(gather_donated_ab(g))
+    # device-resident fabric rungs (round 17) as their own kind line
+    fab = {"ts": time.time(), "kind": "fabric_ab", "platform": plat,
+           "groups": g}
+    fab.update(fabric_serve_ab(min(g, 1024),
+                               micro=max(5, min(40, 20_000 // g))))
+    fab.update(fabric_gather_ab(g))
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
         f.write(json.dumps(pipe) + "\n")
+        f.write(json.dumps(fab) + "\n")
     print(json.dumps(rec), flush=True)
     print(json.dumps(pipe), flush=True)
+    print(json.dumps(fab), flush=True)
 
 
 if __name__ == "__main__":

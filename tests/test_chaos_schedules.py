@@ -64,6 +64,22 @@ def test_schedule_converges_on_pipelined_kernel():
 
 
 @pytest.mark.chaos_fast
+def test_schedule_partitioned_mesh_link_falls_back_to_hub():
+    """Round 17: the same composed schedule against MESH-resident shards
+    (one shared ('g','r') engine, one replica per device).  Partition
+    faults drive the full-row per-link cut mask, delay faults cut this
+    host's mesh links onto the host hub (chaos/runner.py wires both
+    through MeshDispatch.set_cut / set_link_hub_served), so consensus
+    traffic for a cut link falls back to the hub — where the transport
+    fault actually applies — or stalls safely.  The oracle still
+    requires zero acked-entry loss and post-heal convergence.  Seed 7
+    composes partition + kill + delay."""
+    r = run_schedule(7, mesh_resident=True)
+    assert r.report.ok, r.report.failures
+    assert r.acked_count > 0
+
+
+@pytest.mark.chaos_fast
 def test_probe_catches_commit_without_quorum_mutation(monkeypatch):
     """Mutation acceptance for the runtime invariant probe (ISSUE 14):
     a kernel seeded with the commit-without-quorum bug from the model

@@ -22,11 +22,19 @@ micro-steps of randomized leader-masked proposals and randomized ticks
 batch-full paths), generated once per step in router layout and
 permuted onto the mesh rows, so both paths consume identical inputs.
 After every step the mesh ShardState — permuted back to the router's
-group-major layout — must equal the router state bitwise, and the
-mesh's device-side pending count must equal the router inbox's
-occupancy.  Runs on the forced multi-device CPU mesh (conftest sets
+group-major layout — must equal the router state bitwise, box
+included.  Runs on the forced multi-device CPU mesh (conftest sets
 xla_force_host_platform_device_count=8); skips when fewer than 2
 devices are available.
+
+Round 17 adds a THIRD arm: the same randomized schedule driven once
+over the device-resident exchange (open per-link cut mask: messages
+ride the in-step collective) and once over the hub-delivery path
+(every link cut: messages leave via the out-lanes and are staged back
+host-side through the router's slot layout — the same addressing the
+engine's slot-exact _InboxBuilder uses).  The two arms must be
+bitwise-identical at every step, at pipeline depth 0 and 1, proving a
+link falling back to the hub cannot change the state machine.
 
 Both loops run under ``capacity.METER.guard()``
 (``jax.transfer_guard("disallow")``) from step 1 on: step 0 compiles
@@ -45,7 +53,8 @@ from jax.sharding import Mesh
 
 from dragonboat_tpu import capacity as _capacity
 from dragonboat_tpu.core import params as KP
-from dragonboat_tpu.core.router import cluster_step, cluster_step_donated
+from dragonboat_tpu.core import router as _router
+from dragonboat_tpu.core.router import cluster_step, cluster_step_donated, route
 from dragonboat_tpu.parallel.ici import (
     ici_serve_step,
     jit_serve_step_donated,
@@ -149,7 +158,8 @@ def test_engine_kernel_paths_bitwise_equal(seed):
         kp, mesh, num_groups=G_SIZE * N_LOCAL)
     perm = _perm(G_SIZE, REPLICAS, N_LOCAL)
     iperm = np.argsort(perm)  # mesh_row -> router_row source index
-    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+    cut = cluster.shard(
+        np.zeros((cluster.total_rows, kp.num_peers), bool))
 
     # identical starting state, router layout
     state_r = _permute(_pull(state_m), perm)
@@ -174,7 +184,7 @@ def test_engine_kernel_paths_bitwise_equal(seed):
                 inp_m_dev = cluster.shard(inp_m)
                 inp_r_dev = jax.device_put(inp_r)
 
-            state_m, box_m, _, pending = ici_serve_step(
+            state_m, box_m, _ = ici_serve_step(
                 cluster, state_m, box_m, inp_m_dev, cut)
             state_r, box_r, _ = cluster_step(
                 kp, REPLICAS, state_r, box_r, inp_r_dev)
@@ -185,12 +195,7 @@ def test_engine_kernel_paths_bitwise_equal(seed):
                               _pull(state_r))
                 _assert_equal(f"seed {seed} step {step_no} box",
                               _permute(_pull(box_m), perm), _pull(box_r))
-                occupancy = int((np.asarray(box_r.mtype) != 0).sum())
                 committed = int(np.asarray(state_r.committed).max())
-            # the mesh's device-side pending count is the router occupancy
-            with _capacity.METER.sanctioned("mesh_pending"):
-                assert int(pending) == occupancy, (
-                    f"seed {seed} step {step_no}: pending diverged")
             if step_no == 0:
                 guard.enter_context(_capacity.METER.guard())
     finally:
@@ -206,24 +211,23 @@ def test_engine_kernel_paths_bitwise_equal_depth1(seed):
 
     Mirrors the engine's retire-before-dispatch protocol: step N-1's
     state/box are pulled to the host (retired) BEFORE step N's dispatch
-    donates the device buffers to XLA, inputs are built from the retired
-    copies, and the mesh's device-side pending scalar is consumed one
-    step late — exactly how KernelEngine.step_all at pipeline_depth=1
-    consumes MeshDispatch's deferred count."""
+    donates the device buffers to XLA and inputs are built from the
+    retired copies — exactly how KernelEngine.step_all at
+    pipeline_depth=1 runs MeshDispatch."""
     kp = _kp(REPLICAS)
     mesh = _mesh(G_SIZE, REPLICAS)
     cluster, state_m, box_m = make_ici_cluster(
         kp, mesh, num_groups=G_SIZE * N_LOCAL)
     perm = _perm(G_SIZE, REPLICAS, N_LOCAL)
     iperm = np.argsort(perm)
-    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+    cut = cluster.shard(
+        np.zeros((cluster.total_rows, kp.num_peers), bool))
 
     state_r = _permute(_pull(state_m), perm)
     box_r = _permute(_pull(box_m), perm)
 
     rng = np.random.default_rng(seed)
     committed = 0
-    pending_dev = None
     guard = contextlib.ExitStack()  # entered after the compile step
     try:
         for step_no in range(STEPS):
@@ -239,14 +243,6 @@ def test_engine_kernel_paths_bitwise_equal_depth1(seed):
                           st_m, st_r)
             _assert_equal(f"seed {seed} step {step_no} box (depth1)",
                           bx_m, bx_r)
-            if pending_dev is not None:
-                # the deferred device scalar from step N-1's dispatch
-                # must equal the router inbox occupancy after step N-1
-                with _capacity.METER.sanctioned("mesh_pending"):
-                    assert int(pending_dev) == int(
-                        (bx_r.mtype != 0).sum()), (
-                        f"seed {seed} step {step_no}: pending diverged "
-                        "(depth1)")
             committed = int(st_r.committed.max())
 
             draws = rng.bit_generator.state
@@ -257,7 +253,7 @@ def test_engine_kernel_paths_bitwise_equal_depth1(seed):
                 inp_m_dev = cluster.shard(inp_m)
                 inp_r_dev = jax.device_put(inp_r)
 
-            state_m, box_m, _, pending_dev = jit_serve_step_donated(
+            state_m, box_m, _ = jit_serve_step_donated(
                 kp, cluster, state_m, box_m, inp_m_dev, cut)
             state_r, box_r, _ = cluster_step_donated(
                 kp, REPLICAS, state_r, box_r, inp_r_dev)
@@ -271,6 +267,105 @@ def test_engine_kernel_paths_bitwise_equal_depth1(seed):
                   _permute(_pull(state_m), perm), _pull(state_r))
     _assert_equal(f"seed {seed} final box (depth1)",
                   _permute(_pull(box_m), perm), _pull(box_r))
-    assert int(pending_dev) == int(
-        (np.asarray(box_r.mtype) != 0).sum()), "final pending diverged"
     assert committed > 0, "depth-1 differential ran but never committed"
+
+
+def _audit_slots(box_np, R: int) -> None:
+    """Every occupied inbox slot must be one the hub's slot-exact
+    builder would have picked for that (target, source, type) — pins
+    core/router.slot_candidates against route()'s actual placement."""
+    mt, frm = box_np.mtype, box_np.from_
+    rows, K = mt.shape
+    for row in range(rows):
+        t_rid = row % R + 1
+        for k in range(K):
+            m = int(mt[row, k])
+            if m == 0:
+                continue
+            cands = _router.slot_candidates(t_rid, int(frm[row, k]), R, m)
+            assert k in cands, (
+                f"row {row} slot {k}: type {m} from {int(frm[row, k])} "
+                f"landed outside its slot candidates {cands}")
+
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_resident_exchange_bitwise_matches_hub_delivery(depth):
+    """Third arm (round 17): device-resident exchange vs hub delivery.
+
+    Arm A serves with an all-open per-link mask — messages ride the
+    in-step collective.  Arm B serves with EVERY link cut — the step
+    emits but exchanges nothing on the mesh, and the host stages the
+    out-lanes back through the router slot layout (the hub fallback's
+    addressing, core/router.slot_candidates) as the next step's inbox.
+    The same router-layout randomness drives both arms; their states
+    must stay bitwise-identical for 300 micro-steps at pipeline depth 0
+    (lockstep entries) and depth 1 (donated entries, retire-before-
+    dispatch), and every hub-staged slot must be one the slot-exact
+    builder would have picked.  This is the proof that a link falling
+    back to the hub cannot change the state machine — only where the
+    bytes travel."""
+    kp = _kp(REPLICAS)
+    mesh = _mesh(G_SIZE, REPLICAS)
+    cluster, state_a, box_a = make_ici_cluster(
+        kp, mesh, num_groups=G_SIZE * N_LOCAL)
+    perm = _perm(G_SIZE, REPLICAS, N_LOCAL)
+    iperm = np.argsort(perm)
+    total = cluster.total_rows
+    cut_open = cluster.shard(np.zeros((total, kp.num_peers), bool))
+    cut_all = cluster.shard(np.ones((total, kp.num_peers), bool))
+
+    # arm B starts from a bitwise copy of arm A's state (fresh buffers:
+    # the depth-1 arm donates, so the two arms cannot share storage)
+    with _capacity.METER.sanctioned("retire"):
+        init_np, box_np = _pull(state_a), _pull(box_a)
+    state_b, box_b = cluster.shard(init_np), cluster.shard(box_np)
+
+    def serve(state, box, inp, cutm):
+        if depth == 0:
+            return ici_serve_step(cluster, state, box, inp, cutm)
+        return jit_serve_step_donated(kp, cluster, state, box, inp, cutm)
+
+    route_jit = jax.jit(route, static_argnums=(0, 1))
+    rng = np.random.default_rng(7)
+    committed = 0
+    guard = contextlib.ExitStack()  # entered after the compile step
+    try:
+        for step_no in range(STEPS):
+            with _capacity.METER.sanctioned("retire"):
+                st_a, st_b = _pull(state_a), _pull(state_b)
+            _assert_equal(f"depth {depth} step {step_no} arm state",
+                          st_a, st_b)
+            committed = int(st_a.committed.max())
+
+            draws = rng.bit_generator.state  # same draws for both arms
+            inp_a = _random_input(kp, rng, st_a, iperm)
+            rng.bit_generator.state = draws
+            inp_b = _random_input(kp, rng, st_b, iperm)
+            with _capacity.METER.sanctioned("input_up"):
+                inp_a_dev = cluster.shard(inp_a)
+                inp_b_dev = cluster.shard(inp_b)
+
+            state_a, box_a, _ = serve(state_a, box_a, inp_a_dev, cut_open)
+            state_b, box_ret, out_b = serve(
+                state_b, box_b, inp_b_dev, cut_all)
+
+            with _capacity.METER.sanctioned("retire"):
+                ret_np = _pull(box_ret)
+                out_rt = _permute(_pull(out_b), perm)   # router layout
+                box_a_np = _permute(_pull(box_a), perm)
+            assert not ret_np.mtype.any(), (
+                "all-links-cut serve leaked traffic onto the mesh")
+            # hub delivery: route the emitted lanes host-side and stage
+            # the result as arm B's next inbox
+            with _capacity.METER.sanctioned("hub_route"):
+                hub_box = _pull(route_jit(kp, REPLICAS, out_rt))
+            _assert_equal(f"depth {depth} step {step_no} arm box",
+                          box_a_np, hub_box)
+            _audit_slots(hub_box, REPLICAS)
+            with _capacity.METER.sanctioned("inbox_up"):
+                box_b = cluster.shard(_permute(hub_box, iperm))
+            if step_no == 0:
+                guard.enter_context(_capacity.METER.guard())
+    finally:
+        guard.close()
+    assert committed > 0, "third-arm differential ran but never committed"

@@ -246,6 +246,39 @@ def test_disabled_meter_is_noop_and_scrub_drops_census():
                    "p50_commit_host_hops": 0.0, "hop_counts": {}}
 
 
+def test_link_classes_snapshot_validate_and_doctor_render():
+    """Round 17 carrier classes: set_link_class lands in the snapshot,
+    validate_fabric gates the vocabulary, drop_link_classes forgets a
+    detached endpoint both directions, and fleet_doctor renders the
+    resident/hub split."""
+    m = make_meter()
+    m.set_link_class("nh-a", "nh-b", "resident")
+    m.set_link_class("nh-b", "nh-a", "resident")
+    m.set_link_class("nh-a", "nh-c", "hub")
+    with pytest.raises(ValueError, match="unknown link class"):
+        m.set_link_class("nh-a", "nh-d", "warp")
+    snap = m.snapshot()
+    assert snap["link_classes"] == {"nh-a->nh-b": "resident",
+                                    "nh-b->nh-a": "resident",
+                                    "nh-a->nh-c": "hub"}
+    validate_fabric(snap)
+    bad = json.loads(json.dumps(snap))
+    bad["link_classes"]["nh-a->nh-b"] = "warp"
+    with pytest.raises(ValueError, match="unknown link class"):
+        validate_fabric(bad)
+    bad = json.loads(json.dumps(snap))
+    del bad["link_classes"]
+    with pytest.raises(ValueError, match="link_classes"):
+        validate_fabric(bad)
+    fd = _load_script("fleet_doctor")
+    out = fd.render_fabric(snap)
+    assert "link classes: hub=1 resident=2" in out
+    assert "resident: nh-a->nh-b nh-b->nh-a" in out
+    assert "hub: nh-a->nh-c" in out
+    m.drop_link_classes("nh-b")
+    assert m.snapshot()["link_classes"] == {"nh-a->nh-c": "hub"}
+
+
 def test_validate_fabric_rejections():
     m = make_meter()
     m.on_send("nh-a", "nh-b", (pb.Message(
@@ -501,6 +534,96 @@ def test_hop_census_matches_pure_python_recount(monkeypatch):
     assert len(hops_done) == len(finished)
     assert sorted(hops_done) == recount, (hops_done, recount)
     assert all(h >= 2 for h in recount)   # out + quorum ack, minimum
+
+
+# -- hop-census regression: device-resident fabric (round 17) ----------------
+
+# PR 19 measured the co-located quorum round over the host hub: every
+# sampled commit crossed it 4 times (fabric.p50_commit_host_hops = 4.0).
+# Round 17 moves co-located consensus onto the mesh, so the commit path
+# must stop touching the hub entirely.
+_PR19_P50_COMMIT_HOST_HOPS = 4.0
+
+
+def _mesh_cluster(prefix):
+    from dragonboat_tpu.config import MeshSpec
+
+    spec = MeshSpec(name=prefix, g_size=2, replicas=3, n_local=4)
+    addrs = {i: f"{prefix}-{i}" for i in range(1, 4)}
+    hosts = {}
+    for rid, addr in addrs.items():
+        nh = NodeHost(NodeHostConfig(
+            raft_address=addr, rtt_millisecond=5,
+            expert=ExpertConfig(mesh=spec, kernel_log_cap=256,
+                                kernel_apply_batch=16,
+                                kernel_compaction_overhead=16,
+                                trace_sample_every=1)))
+        nh.start_replica(addrs, False, KVStateMachine, Config(
+            shard_id=1, replica_id=rid, election_rtt=10, heartbeat_rtt=2,
+            compaction_overhead=5, mesh_resident=True))
+        hosts[rid] = nh
+    return hosts
+
+
+def test_hop_census_mesh_colocated_commits_skip_the_hub():
+    """Mesh-co-located replicas commit WITHOUT the host hub: sampled
+    commit traces carry zero hub_send/hub_recv stamps and the hop
+    census medians 0 (down from the PR 19 co-located baseline of 4.0).
+    Off-mesh links (a host-resident cluster in the same process) still
+    stamp hub spans — the census distinguishes link classes, it does
+    not go blind."""
+    hub_stages = {lifecycle.STAGE_HUB_SEND, lifecycle.STAGE_HUB_RECV}
+
+    hosts = _mesh_cluster(f"fabmesh{time.monotonic_ns()}")
+    try:
+        lead = wait_leader(hosts, timeout=60)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        for i in range(8):
+            propose_retry(nh, sess, f"m{i}=v{i}".encode())
+        deadline = time.time() + 30
+        done = []
+        while time.time() < deadline:
+            done = [tr for tr in lifecycle.TRACER.completed()
+                    if tr.get("kind") == lifecycle.KIND_PROPOSAL]
+            if len(done) >= 5:
+                break
+            propose_retry(nh, sess, b"mz=1")
+            time.sleep(0.1)
+        assert len(done) >= 5, "no sampled commit traces completed"
+        for tr in done:
+            stamps = {s for s, _ in tr["stamps"]}
+            assert not (stamps & hub_stages), (
+                f"co-located commit trace crossed the host hub: {stamps}")
+        p50 = fabric.METER.snapshot()["census"]["p50_commit_host_hops"]
+        assert p50 < _PR19_P50_COMMIT_HOST_HOPS, p50
+        assert p50 == 0.0, (
+            f"mesh-co-located commits still hop the hub (p50={p50})")
+    finally:
+        close_all(hosts)
+
+    # off-mesh arm: host-resident replicas in the SAME process still
+    # stamp their hub crossings (the instrumentation did not go dark)
+    lifecycle.TRACER.reset()
+    fabric.METER.reset()
+    hosts = _chan_cluster(f"fabhub{time.monotonic_ns()}", 0)
+    try:
+        lead = wait_leader(hosts, timeout=30)
+        nh = hosts[lead]
+        sess = nh.get_noop_session(1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            propose_retry(nh, sess, b"h=1")
+            if any({s for s, _ in tr["stamps"]} & hub_stages
+                   for tr in lifecycle.TRACER.completed()
+                   if tr.get("kind") == lifecycle.KIND_PROPOSAL):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "off-mesh commit traces lost their hub stamps")
+    finally:
+        close_all(hosts)
 
 
 # -- chaos: partitions and delays land in the link telemetry -----------------

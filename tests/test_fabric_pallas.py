@@ -1,0 +1,87 @@
+"""Fabric pallas kernels (round 17): the device-resident serving
+path's two hot gather shapes — inbox lane staging and the quorum match
+order statistic — as VMEM block kernels (parallel/fabric_pallas.py),
+pinned bit-identical to their XLA lowerings in interpret mode, plus a
+CPU-interpreted smoke of the scripts/tpu_pallas_ab.py ``fabric_ab``
+rungs (the compiled numbers need real TPU hardware; the plumbing and
+the bitwise flags do not)."""
+
+import importlib.util
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonboat_tpu.parallel.fabric_pallas import (
+    gather_lanes_pallas,
+    gather_lanes_xla,
+    quorum_match_pallas,
+    quorum_match_xla,
+)
+
+
+@pytest.mark.parametrize("G,K,M", [(8, 16, 16), (13, 32, 8), (1, 8, 8)])
+def test_gather_lanes_bitwise(G, K, M):
+    """Pallas lane gather == take_along_axis for in-range indexes,
+    including row counts that force the pad path."""
+    rng = np.random.default_rng(5)
+    vals = jnp.asarray(rng.integers(-(1 << 20), 1 << 20, (G, K)),
+                       jnp.int32)
+    idx = jnp.asarray(rng.integers(0, K, (G, M)), jnp.int32)
+    ref = gather_lanes_xla(vals, idx)
+    got = gather_lanes_pallas(vals, idx, interpret=True)
+    assert jnp.array_equal(ref, got)
+
+
+def test_gather_lanes_sentinel_reads_zero():
+    """idx == K (the router's no-lane sentinel) has no hot slot in the
+    one-hot and must read 0, matching route()'s onehot_reads branch."""
+    vals = jnp.asarray([[7, 8, 9, 10]], jnp.int32)
+    idx = jnp.asarray([[4, 2, 4, 0]], jnp.int32)
+    got = gather_lanes_pallas(vals, idx, interpret=True)
+    assert got.tolist() == [[0, 9, 0, 7]]
+
+
+@pytest.mark.parametrize("seed", [1, 9])
+def test_quorum_match_bitwise(seed):
+    """Compare-count rank select == the sort+gather reference across
+    randomized matches, voting masks and quorums — duplicates, fewer
+    voters than quorum, and zero-voter rows included."""
+    rng = np.random.default_rng(seed)
+    G, R = 64, 8
+    # small value range to force duplicate matches (the tie path)
+    match = jnp.asarray(rng.integers(0, 6, (G, R)), jnp.int32)
+    voting = jnp.asarray(rng.random((G, R)) < 0.7)
+    voting = voting.at[0].set(False)        # zero-voter row
+    quorum = jnp.asarray(rng.integers(1, R + 1, G), jnp.int32)
+    ref = quorum_match_xla(match, voting, quorum)
+    got = quorum_match_pallas(match, voting, quorum, interpret=True)
+    assert jnp.array_equal(ref, got), (
+        np.argwhere(~np.asarray(ref == got)))
+
+
+def _load_ab_script():
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "tpu_pallas_ab.py")
+    spec = importlib.util.spec_from_file_location("_fabric_pallas_ab",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fabric_ab_rungs_smoke():
+    """The kind=fabric_ab rungs run end-to-end on the forced-CPU
+    multi-device mesh: the serve A/B produces both arm timings (hub
+    arm slower or not — meaningless on CPU, present either way) and
+    the gather A/B's bitwise flags hold."""
+    mod = _load_ab_script()
+    serve = mod.fabric_serve_ab(8, micro=3)
+    assert "serve_error" not in serve, serve
+    assert "resident_step_ms" in serve and "hub_step_ms" in serve, serve
+    gather = mod.fabric_gather_ab(64, iters=2)
+    assert gather.get("inbox_gather_bitwise") is True, gather
+    assert gather.get("quorum_match_bitwise") is True, gather

@@ -107,8 +107,9 @@ def test_ici_bitwise_matches_router(g_size, replicas, n_local):
 
 
 def test_serve_step_with_open_mask_matches_router():
-    """The serving-path body (host-staged input + persistent box + cut
-    mask) with an all-open mask is the router path bit for bit."""
+    """The serving-path body (host-staged input + persistent box + per-
+    link cut mask) with an all-open mask is the router path bit for
+    bit — state AND carried inbox."""
     g_size, replicas, n_local = 2, 3, 4
     kp = _kp(replicas)
     mesh = _mesh(g_size, replicas)
@@ -117,19 +118,20 @@ def test_serve_step_with_open_mask_matches_router():
     perm = _perm(g_size, replicas, n_local)
     state_r = _permute(_pull(state_m), perm)
     box_r = _permute(_pull(box_m), perm)
-    cut = cluster.shard(np.zeros((cluster.total_rows,), bool))
+    cut = cluster.shard(
+        np.zeros((cluster.total_rows, kp.num_peers), bool))
 
     for step_no in range(40):
         inp_m = self_driving_input(kp, state_m, tick=True, propose=True)
         inp_r = self_driving_input(
             kp, jax.tree.map(np.asarray, state_r), tick=True, propose=True)
-        state_m, box_m, _, pending = ici_serve_step(
+        state_m, box_m, _ = ici_serve_step(
             cluster, state_m, box_m, cluster.shard(inp_m), cut)
         state_r, box_r, _ = cluster_step(kp, replicas, state_r, box_r, inp_r)
         _assert_equal(f"serve step {step_no}",
                       _permute(_pull(state_m), perm), _pull(state_r))
-        # pending agrees with the router's own box occupancy
-        assert int(pending) == int((np.asarray(box_r.mtype) != 0).sum())
+        _assert_equal(f"serve step {step_no} box",
+                      _permute(_pull(box_m), perm), _pull(box_r))
 
 
 def test_serve_step_cut_row_is_isolated():
@@ -145,10 +147,11 @@ def test_serve_step_cut_row_is_isolated():
     state_r = _permute(_pull(state_m), perm)
     box_r = _permute(_pull(box_m), perm)
 
-    # cut replica 2 of group 0 (mesh row for (g=0, ir=1))
-    cut_np = np.zeros((cluster.total_rows,), bool)
+    # cut replica 2 of group 0 (mesh row for (g=0, ir=1)): severing
+    # every link of the row reproduces the whole-row partition
+    cut_np = np.zeros((cluster.total_rows, kp.num_peers), bool)
     cut_mesh_row = _perm(g_size, replicas, n_local)[0 * replicas + 1]
-    cut_np[cut_mesh_row] = True
+    cut_np[cut_mesh_row, :] = True
     cut = cluster.shard(cut_np)
     cut_router_row = 0 * replicas + 1
 
@@ -180,7 +183,7 @@ def test_serve_step_cut_row_is_isolated():
         inp_m = self_driving_input(kp, state_m, tick=True, propose=True)
         inp_r = self_driving_input(
             kp, jax.tree.map(np.asarray, state_r), tick=True, propose=True)
-        state_m, box_m, _, _ = ici_serve_step(
+        state_m, box_m, _ = ici_serve_step(
             cluster, state_m, box_m, cluster.shard(inp_m), cut)
         state_r, box_r, _ = cluster_step(kp, replicas, state_r, box_r, inp_r)
         box_r = drop_router(jax.tree.map(np.asarray, box_r))

@@ -194,6 +194,76 @@ def test_mesh_partitioned_leader_deposed():
         close_all(hosts)
 
 
+def test_mesh_single_link_cut_falls_back_to_hub():
+    """Round 17 per-LINK cut: severing ONE mesh link (leader <->
+    follower) leaves the row serving — traffic for that link leaves the
+    device fabric and rides the host hub instead, so the cut follower
+    keeps replicating with zero acked loss; healing returns the link to
+    the mesh and the hub gate closes behind it."""
+    hosts = make_cluster(f"mshL{time.monotonic_ns()}")
+    try:
+        lid = wait_leader(hosts, timeout=60)
+        nh = hosts[lid]
+        propose_retry(nh, nh.get_noop_session(1), b"pre=cut")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(h.stale_read(1, "pre") == "cut" for h in hosts.values()):
+                break
+            time.sleep(0.05)
+        assert all(h.stale_read(1, "pre") == "cut" for h in hosts.values())
+
+        frid = next(r for r in hosts if r != lid)
+        eng = nh.mesh_engine
+        lnode = eng.by_shard[(1, lid)]
+        fnode = eng.by_shard[(1, frid)]
+        eng.set_link_hub_served(lnode, frid, True)
+        # a link is cut at BOTH endpoints (asymmetric masks could leak
+        # one direction across a link the host already re-routed)
+        assert eng._dispatch.cut[lnode.lane, frid - 1]
+        assert eng._dispatch.cut[fnode.lane, lid - 1]
+        # the rows are NOT partitioned: only one link left the mesh
+        assert not eng._dispatch.cut[lnode.lane].all()
+        assert eng.link_hub_served(lnode, frid)
+        assert eng.link_hub_served(fnode, lid)
+        # the doctor's carrier classes track the cut: this link is now
+        # hub-delivered (both directions), every other link resident
+        from dragonboat_tpu import fabric as _fabric
+        book = eng._link_class_book(lnode)
+        la, fa = book[lid], book[frid]
+        classes = _fabric.METER.snapshot()["link_classes"]
+        assert classes[f"{la}->{fa}"] == "hub"
+        assert classes[f"{fa}->{la}"] == "hub"
+
+        # writes still commit, and the CUT follower still converges —
+        # its replication stream now rides the host hub
+        propose_retry(nh, nh.get_noop_session(1), b"during=cut")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if hosts[frid].stale_read(1, "during") == "cut":
+                break
+            time.sleep(0.05)
+        assert hosts[frid].stale_read(1, "during") == "cut", (
+            "cut link did not fall back to the hub")
+
+        eng.set_link_hub_served(lnode, frid, False)
+        assert not eng._dispatch.cut[lnode.lane].any()
+        assert not eng._dispatch.cut[fnode.lane].any()
+        classes = _fabric.METER.snapshot()["link_classes"]
+        assert classes[f"{la}->{fa}"] == "resident"
+        assert classes[f"{fa}->{la}"] == "resident"
+        propose_retry(nh, nh.get_noop_session(1), b"post=heal")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(h.stale_read(1, "post") == "heal"
+                   for h in hosts.values()):
+                break
+            time.sleep(0.05)
+        assert all(h.stale_read(1, "post") == "heal"
+                   for h in hosts.values())
+    finally:
+        close_all(hosts)
+
+
 def test_mesh_eviction_to_host_engines():
     """Whole-group escalation: after eviction every member continues as a
     host-resident Node on its own NodeHost over the chan transport."""

@@ -490,6 +490,27 @@ def test_emit_ledger_artifact(tmp_path):
                 profile, key)
 
 
+# PR 17 seeded the mesh per-step budget when the serving entry still
+# downloaded a device->host pending scalar every step; round 17 derives
+# drain-pending from the output flags the host already fetches
+_PR17_MESH_DOWN_BYTES = 8196
+_PR17_MESH_DOWN_CROSSINGS = 2
+
+
+def test_mesh_budget_strictly_shrank(tmp_path):
+    """Round 17's device-resident fabric DELETED host crossings from the
+    mesh serving step — the reseeded budget must be strictly below the
+    PR 17 values, and must never regrow past them."""
+    spec = transfer.reseed(REPO, budget_path=str(tmp_path / "b.json"))
+    mesh = spec["budget"]["mesh"]
+    assert mesh["down_bytes_per_step"] < _PR17_MESH_DOWN_BYTES, (
+        "mesh per-step download budget did not shrink vs PR 17 — a "
+        "per-step device->host crossing crept back into the serving "
+        "entry's ledger")
+    assert mesh["down_crossings_per_step"] < _PR17_MESH_DOWN_CROSSINGS, (
+        "mesh per-step download crossings did not shrink vs PR 17")
+
+
 def test_reseed_roundtrip(tmp_path):
     out = str(tmp_path / "budget.json")
     spec = transfer.reseed(REPO, budget_path=out)
